@@ -1,0 +1,24 @@
+"""oktopk_tpu — a TPU-native (JAX/XLA/pjit/Pallas) distributed training framework
+with the capabilities of Shigangli/Ok-Topk (PPoPP'22, arXiv 2201.07598).
+
+The reference implements sparse gradient allreduce over mpi4py on GPU clusters
+(/root/reference/VGG/allreducer.py, LSTM/allreducer.py, BERT/bert/allreducer.py).
+This package re-designs the same capability set TPU-first:
+
+- ``comm``        — mesh + typed collective substrate (replaces mpi4py verbs)
+- ``ops``         — functional compression kernels (replaces compression.py)
+- ``collectives`` — the sparse allreduce algorithms (oktopk + all baselines)
+- ``optim``       — distributed optimizers (SGD, BertAdam) as pure grad transforms
+- ``models``      — Flax model zoo (VGG/ResNet/LSTM/DeepSpeech/BERT, ...)
+- ``data``        — dataset pipelines with distributed sharding
+- ``train``       — trainer, metrics, checkpointing (incl. algorithm state)
+- ``parallel``    — sequence parallelism (ring attention) and pipeline (GPipe) extensions
+"""
+
+__version__ = "0.1.0"
+
+from oktopk_tpu.config import (  # noqa: F401
+    CommConfig,
+    OkTopkConfig,
+    TrainConfig,
+)

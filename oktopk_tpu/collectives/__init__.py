@@ -1,0 +1,27 @@
+"""Sparse allreduce algorithms (the reference's allreducer.py, TPU-native).
+
+Each algorithm is a pure jittable function
+``(grad: f32[n], state: SparseState, cfg: OkTopkConfig) -> (f32[n], SparseState)``
+meant to run *per-shard* inside ``shard_map`` over the ``data`` mesh axis —
+the direct analogue of the reference's per-rank ``AllReducer.run`` body
+(VGG/allreducer.py:549) with MPI verbs replaced by XLA collectives.
+
+Algorithm census (reference names, SURVEY.md §2 C1/C2):
+
+==============  ====================================================
+``dense``       plain psum mean (VGG/allreducer.py:175-180)
+``topkA``       fixed-k allgather (VGG/allreducer.py:34-69)
+``topkA2``      topkA + re-top-k after reduce (VGG/allreducer.py:519-525)
+``topkAopt``    threshold-based allgather variant (VGG/allreducer.py:1100-1151)
+``gtopk``       recursive-halving tree merge (VGG/allreducer.py:76-172)
+``gaussiank``   Gaussian-threshold allgather (VGG/allreducer.py:1420-1465)
+``gaussiankconcat``  packed single-buffer variant (VGG/allreducer.py:1467-1501)
+``gaussiankSA`` ring reduce-scatter variant (VGG/allreducer.py:1503-1620)
+``topkSA``      static-region split-allreduce ("topkDSA")
+                (VGG/allreducer.py:1153-1357)
+``oktopk``      the paper's two-phase algorithm (VGG/allreducer.py:575-1098)
+==============  ====================================================
+"""
+
+from oktopk_tpu.collectives.state import SparseState, init_state  # noqa: F401
+from oktopk_tpu.collectives.registry import get_algorithm, ALGORITHMS  # noqa: F401

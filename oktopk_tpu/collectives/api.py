@@ -1,0 +1,59 @@
+"""Host-level entry points: run a sparse allreduce over a device mesh.
+
+The per-shard algorithm functions (this package) correspond to the body the
+reference runs on every MPI rank; this module is the analogue of wiring them
+into the process world — except the "world" is a ``jax.sharding.Mesh`` and the
+wiring is ``shard_map`` + jit. Also provides the EPS-vs-dense equivalence
+harness mirroring the reference's PROFILING_NORM measurement
+(VGG/allreducer.py:584-606,1072-1080: EPS = ‖dense−sparse‖₂/‖dense‖₂).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from oktopk_tpu.collectives.registry import get_algorithm
+from oktopk_tpu.collectives.state import SparseState, init_state
+from oktopk_tpu.config import OkTopkConfig
+
+
+def batched_init_state(cfg: OkTopkConfig, dtype=jnp.float32) -> SparseState:
+    """Per-worker state stacked on a leading device axis [P, ...] so it can be
+    sharded over the data axis (each worker owns its residual/thresholds,
+    as each rank does in the reference)."""
+    s = init_state(cfg, dtype)
+    return jax.tree.map(
+        lambda x: jnp.broadcast_to(x, (cfg.num_workers,) + x.shape), s)
+
+
+def build_allreduce_step(name: str, cfg: OkTopkConfig, mesh: Mesh,
+                         axis_name: str = "data", warmup: bool = True):
+    """jit-compiled ``(grads [P, n], state) -> (results [P, n], state)``.
+
+    ``results`` is the same reduced vector replicated per worker row (every
+    rank gets the full result, as after the reference's allgather phase).
+    """
+    algo = get_algorithm(name, warmup=warmup)
+    spec = P(axis_name)
+
+    def shard_fn(g, s):
+        g1 = g[0]
+        s1 = jax.tree.map(lambda x: x[0], s)
+        out, s2 = algo(g1, s1, cfg, axis_name)
+        return out[None], jax.tree.map(lambda x: x[None], s2)
+
+    mapped = jax.shard_map(shard_fn, mesh=mesh,
+                           in_specs=(spec, spec), out_specs=(spec, spec))
+    return jax.jit(mapped)
+
+
+@partial(jax.jit, static_argnames=())
+def eps_vs_dense(dense_result: jnp.ndarray, sparse_result: jnp.ndarray):
+    """EPS = ‖dense − sparse‖₂ / ‖dense‖₂ (reference VGG/allreducer.py:1072-1080)."""
+    num = jnp.linalg.norm(dense_result - sparse_result)
+    den = jnp.linalg.norm(dense_result) + 1e-12
+    return num / den

@@ -1,0 +1,43 @@
+"""Dense allreduce baseline + the shared warmup wrapper.
+
+Reference: the ``dense`` compressor branch (VGG/allreducer.py:175-180,532-547)
+and the dense-allreduce warmup that every sparse algorithm starts with
+(512 iters for VGG, VGG/allreducer.py:573; 128 for LSTM; disabled for BERT).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax.numpy as jnp
+from jax import lax
+
+from oktopk_tpu.collectives.state import SparseState, bump
+from oktopk_tpu.config import OkTopkConfig
+
+
+def dense_allreduce(grad: jnp.ndarray, state: SparseState, cfg: OkTopkConfig,
+                    axis_name: str = "data"):
+    """psum-mean over the data axis (ring allreduce moves ~2n per worker)."""
+    out = lax.pmean(grad, axis_name)
+    return out, bump(state, volume=2.0 * cfg.n,
+                     local_count=cfg.n, global_count=cfg.n)
+
+
+def with_warmup(algo_fn):
+    """Run dense allreduce for the first ``cfg.warmup_steps`` steps, then the
+    sparse algorithm (reference VGG/allreducer.py:573-574). Both branches are
+    traced with identical shapes, as ``lax.cond`` requires."""
+
+    def wrapped(grad, state, cfg: OkTopkConfig, axis_name: str = "data"):
+        if cfg.warmup_steps <= 0:
+            return algo_fn(grad, state, cfg, axis_name)
+        return lax.cond(
+            state.step < cfg.warmup_steps,
+            partial(dense_allreduce, cfg=cfg, axis_name=axis_name),
+            partial(algo_fn, cfg=cfg, axis_name=axis_name),
+            grad, state,
+        )
+
+    wrapped.__name__ = f"warmup({getattr(algo_fn, '__name__', 'algo')})"
+    return wrapped

@@ -1,0 +1,44 @@
+"""gaussiank family: Gaussian-threshold allgather allreduces.
+
+Reference: ``gaussiank`` (VGG/allreducer.py:1420-1465), ``gaussiankconcat``
+(VGG/allreducer.py:1467-1501). The point of the family is to avoid exact
+top-k entirely: the threshold comes from a normal fit + bounded refinement
+each step (ops/gaussian.py), so there is never an O(n log n) sort.
+
+``gaussiankconcat`` differs from ``gaussiank`` only in wire layout (one packed
+[indexes‖values] buffer instead of two Allgatherv calls). On TPU both are one
+``all_gather`` of a fixed-capacity triple — same compiled program — so the
+registry maps both names to this function; the distinction is kept only for
+flag parity with the reference.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from oktopk_tpu.collectives.state import SparseState, bump
+from oktopk_tpu.comm import all_gather, psum
+from oktopk_tpu.config import OkTopkConfig
+from oktopk_tpu.ops import gaussian_threshold, scatter_sparse, select_by_threshold
+from oktopk_tpu.ops.residual import add_residual, update_residual_at_selection
+
+
+def gaussian_k(grad: jnp.ndarray, state: SparseState, cfg: OkTopkConfig,
+               axis_name: str = "data"):
+    P, n, k = cfg.num_workers, cfg.n, cfg.k
+    cap = cfg.cap_local
+    acc = add_residual(grad, state.residual)
+
+    t = gaussian_threshold(acc, k, cfg.gaussian_refine_iters).astype(acc.dtype)
+    vals, idx, count = select_by_threshold(acc, t, cap)
+    packed_mask = jnp.zeros((n,), bool).at[idx].set(True, mode="drop")
+    residual = update_residual_at_selection(acc, packed_mask)
+
+    gv = all_gather(vals, axis_name)          # [P, cap]
+    gi = all_gather(idx, axis_name)
+    result = scatter_sparse(n, gv, gi) / P
+
+    total = psum(count, axis_name)
+    return result, bump(state, volume=2.0 * total, residual=residual,
+                        local_threshold=t,
+                        local_count=count, global_count=total)

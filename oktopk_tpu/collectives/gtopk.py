@@ -1,0 +1,54 @@
+"""gTop-k: recursive-halving tree merge of top-k pairs.
+
+Reference: ``gtopk_sparse_allreduce`` (VGG/allreducer.py:76-172), from the
+gTop-k SGD paper. The reference does log2(P) rounds of paired Send/Recv where
+the receiver merges two k-sparse lists and re-selects top-k, then rank 0
+Bcasts the final result (:162).
+
+TPU form: a symmetric butterfly — every round exchanges with the partner at
+XOR distance d via ``ppermute`` and *both* sides merge, so after log2(P)
+rounds every worker already holds the identical global result and the final
+Bcast disappears. Merging two k-sparse lists is a scatter-add into a dense
+staging vector followed by ``lax.top_k`` (duplicate indices sum, as in the
+reference's merge at :130-140).
+
+Volume: 2k scalars sent + 2k received per round × log2(P) rounds.
+Requires P to be a power of two (the reference's recursive halving does too).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from oktopk_tpu.collectives.state import SparseState, bump
+from oktopk_tpu.comm import psum
+from oktopk_tpu.comm.primitives import ppermute_pair
+from oktopk_tpu.config import OkTopkConfig
+from oktopk_tpu.ops import exact_topk, scatter_sparse
+from oktopk_tpu.ops.residual import add_residual, update_residual_at_selection
+
+
+def gtopk(grad: jnp.ndarray, state: SparseState, cfg: OkTopkConfig,
+          axis_name: str = "data"):
+    P, n, k = cfg.num_workers, cfg.n, cfg.k
+    if P & (P - 1):
+        raise ValueError(f"gtopk requires power-of-two workers, got {P}")
+    acc = add_residual(grad, state.residual)
+    vals, idx = exact_topk(acc, k)
+    sel_mask = jnp.zeros((n,), bool).at[idx].set(True)
+    residual = update_residual_at_selection(acc, sel_mask)
+
+    rounds = P.bit_length() - 1
+    d = 1
+    for _ in range(rounds):
+        pv = ppermute_pair(vals, axis_name, d)
+        pi = ppermute_pair(idx, axis_name, d)
+        merged = scatter_sparse(n, jnp.concatenate([vals, pv]),
+                                jnp.concatenate([idx, pi]))
+        vals, idx = exact_topk(merged, k)
+        d <<= 1
+
+    result = scatter_sparse(n, vals, idx) / P
+    vol = 4.0 * k * rounds
+    return result, bump(state, volume=vol, residual=residual,
+                        local_count=k, global_count=k)

@@ -1,0 +1,167 @@
+"""Ok-Topk: the paper's two-phase O(6k) sparse allreduce, TPU-native.
+
+Reference: the oktopk branch of ``AllReducer.run`` (VGG/allreducer.py:575-1098;
+call-stack walkthrough in SURVEY.md §3.2). Phase (a) is a reduce-scatter-like
+exchange into per-worker *load-balanced regions*; phase (b) allgathers each
+region's globally-selected winners. Thresholds are predicted (multiplicative
+adaptation) and only recomputed exactly every ``*_recompute_every`` steps;
+regions are repartitioned from local top-k index density every
+``repartition_every`` steps.
+
+TPU-first mapping (SURVEY.md §5.8, §7.3):
+- the throttled tagged Isend/Irecv rounds (reference :672-794) collapse into
+  ONE ``lax.all_to_all`` over fixed-capacity [P, cap] buffers — the rotated
+  dst/src schedule, the size Alltoall (:708) and the chunked overlap logic all
+  vanish (XLA pipelines the collective with surrounding compute);
+- ``torch.split`` by data-dependent boundaries (:667-670) becomes region-id
+  masks + one packing scatter (ops/select.pack_by_region) — shapes stay
+  static;
+- the two ``Allgatherv`` calls (:819,1031) become ``lax.all_gather`` of
+  fixed-capacity triples;
+- the boundary-averaging ``MPI.Allreduce`` (:638) is a tiny ``psum``;
+- iteration-dependent control flow (recompute vs predict) is ``lax.cond`` on
+  the step counter carried in SparseState — both branches same shapes.
+
+Communication volume (analytic, tracked in SparseState): phase (a) sends
+~2k and receives ~2k (balanced regions), phase (b) sends ~2k/P and receives
+~2k(P-1)/P — total < 6k scalars per worker per step, the paper's headline
+(reference README.md:2).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+from oktopk_tpu.collectives.state import SparseState, bump
+from oktopk_tpu.comm import all_gather, all_to_all, axis_rank, psum
+from oktopk_tpu.config import OkTopkConfig
+from oktopk_tpu.ops import (
+    exact_topk,
+    k2threshold,
+    pack_by_region,
+    scatter_sparse,
+    select_by_threshold,
+)
+from oktopk_tpu.ops.residual import add_residual, update_residual_at_winners
+
+
+def _adapt(thresh, count, k, scale, lo, hi):
+    """Grow/shrink the threshold toward the [lo*k, hi*k] count band
+    (reference VGG/allreducer.py:696-699, :1054-1057)."""
+    s = jnp.where(count > hi * k, scale,
+                  jnp.where(count < lo * k, 1.0 / scale, 1.0))
+    return thresh * s
+
+
+def _repartition(abs_acc, local_thresh, cfg: OkTopkConfig, axis_name: str):
+    """Load-balanced region boundaries from local selection density.
+
+    The reference takes equal-count quantiles of its own top-k indices and
+    averages the boundaries across workers with an MPI.Allreduce
+    (VGG/allreducer.py:626-654). Here: cumulative hit count -> searchsorted
+    quantile cut points -> psum-mean -> monotonic int offsets. Invariant
+    preserved: boundaries[0] == 0, boundaries[-1] == n (the reference asserts
+    sum(region sizes) == n at :648).
+    """
+    P, n = cfg.num_workers, cfg.n
+    mask = abs_acc >= local_thresh
+    csum = jnp.cumsum(mask.astype(jnp.int32))
+    total = csum[-1]
+    targets = (jnp.arange(1, P) * total).astype(jnp.float32) / P
+    interior = jnp.searchsorted(
+        csum.astype(jnp.float32), targets, side="left").astype(jnp.float32)
+    avg = psum(interior, axis_name) / P
+    interior_i = jnp.clip(jnp.round(avg).astype(jnp.int32), 0, n)
+    interior_i = jnp.sort(interior_i)
+    return jnp.concatenate([
+        jnp.zeros((1,), jnp.int32), interior_i,
+        jnp.full((1,), n, jnp.int32)])
+
+
+def oktopk(grad: jnp.ndarray, state: SparseState, cfg: OkTopkConfig,
+           axis_name: str = "data"):
+    P, n, k = cfg.num_workers, cfg.n, cfg.k
+    rank = axis_rank(axis_name)
+    acc = add_residual(grad, state.residual)
+    abs_acc = jnp.abs(acc)
+
+    # ---- local threshold: exact every local_recompute_every, else predicted
+    # (reference VGG/allreducer.py:593 vs :696-699).
+    lt = lax.cond(state.step % cfg.local_recompute_every == 0,
+                  lambda: k2threshold(abs_acc, k).astype(acc.dtype),
+                  lambda: state.local_threshold)
+
+    # ---- region repartition every repartition_every steps (reference :626-654).
+    boundaries = lax.cond(
+        state.step % cfg.repartition_every == 0,
+        lambda: _repartition(abs_acc, lt, cfg, axis_name),
+        lambda: state.boundaries)
+
+    # ---- phase (a): select, exchange to region owners, scatter-add reduce.
+    mask = abs_acc >= lt
+    local_count = jnp.sum(mask)
+    s_vals, s_idx, s_counts = pack_by_region(
+        acc, mask, boundaries, P, cfg.cap_pair)
+    r_vals = all_to_all(s_vals, axis_name)     # [P, cap_pair]
+    r_idx = all_to_all(s_idx, axis_name)
+    reduced = scatter_sparse(n, r_vals, r_idx)  # nonzero only in own region
+
+    recv_count = jnp.sum(r_idx < n)
+    own_count = s_counts[rank]
+    vol_a = 2.0 * (local_count - own_count) + 2.0 * (recv_count - own_count)
+
+    # threshold feedback for the next step
+    lt_next = _adapt(lt, local_count, k, cfg.local_adapt_scale,
+                     cfg.band_lo, cfg.band_hi)
+
+    # ---- phase (b): global winner selection + allgather.
+    cap_g = cfg.cap_gather
+    k_cand = min(k, n)
+
+    def exact_branch():
+        # Every global_recompute_every steps the reference gathers all
+        # nonzeros and takes an exact global top-k (VGG/allreducer.py:819-846).
+        # TPU form: each region contributes its top-k_cand candidates (a
+        # region can hold at most k of the global top-k), exact k-th value of
+        # the gathered pool becomes the new global threshold.
+        vals, idx = exact_topk(reduced, k_cand)
+        gv = all_gather(vals, axis_name)               # [P, k_cand]
+        gi = all_gather(idx, axis_name)
+        gt = k2threshold(jnp.abs(gv).reshape(-1), k).astype(acc.dtype)
+        keep = jnp.abs(gv) >= gt
+        result = scatter_sparse(n, jnp.where(keep, gv, 0.0),
+                                jnp.where(keep, gi, n))
+        g_count = jnp.sum(keep)
+        vol = 2.0 * k_cand + 2.0 * k_cand * (P - 1)
+        return result, gt, g_count, vol
+
+    def predicted_branch():
+        # Otherwise: threshold-select own region, fixed-capacity allgather,
+        # rebuild, adapt the global threshold (reference :894,1031-1057).
+        gvals, gidx, gcount = select_by_threshold(
+            reduced, state.global_threshold, cap_g)
+        gv = all_gather(gvals, axis_name)              # [P, cap_g]
+        gi = all_gather(gidx, axis_name)
+        result = scatter_sparse(n, gv, gi)
+        total_g = psum(gcount, axis_name)
+        gt_next = _adapt(state.global_threshold, total_g, k,
+                         cfg.global_adapt_scale, cfg.band_lo, cfg.band_hi)
+        vol = 2.0 * gcount + 2.0 * (total_g - gcount)
+        return result, gt_next, total_g, vol
+
+    result, gt_next, g_count, vol_b = lax.cond(
+        state.step % cfg.global_recompute_every == 0,
+        exact_branch, predicted_branch)
+
+    result = result / P
+
+    # ---- residual: zero only at indices that made the global result
+    # (reference VGG/allreducer.py:1051-1052).
+    winner_mask = result != 0.0
+    residual = update_residual_at_winners(acc, winner_mask)
+
+    return result, bump(state, volume=vol_a + vol_b, residual=residual,
+                        local_threshold=lt_next, global_threshold=gt_next,
+                        boundaries=boundaries,
+                        local_count=local_count, global_count=g_count)

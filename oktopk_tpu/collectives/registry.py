@@ -1,0 +1,41 @@
+"""Algorithm registry (reference ``compressors`` dict,
+VGG/compression.py:512-523, + the ``--compressor`` dispatch in
+``AllReducer.run``, VGG/allreducer.py:481-547)."""
+
+from __future__ import annotations
+
+from oktopk_tpu.collectives.dense import dense_allreduce, with_warmup
+from oktopk_tpu.collectives.gaussiank import gaussian_k
+from oktopk_tpu.collectives.gtopk import gtopk
+from oktopk_tpu.collectives.oktopk import oktopk
+from oktopk_tpu.collectives.topk_allgather import topk_a, topk_a2, topk_a_opt
+from oktopk_tpu.collectives.topk_sa import gaussian_k_sa, topk_sa
+
+ALGORITHMS = {
+    "dense": dense_allreduce,
+    "topkA": topk_a,
+    "topkA2": topk_a2,
+    "topkAopt": topk_a_opt,
+    "gtopk": gtopk,
+    "gaussiank": gaussian_k,
+    # Same compiled program on TPU; see gaussiank.py docstring.
+    "gaussiankconcat": gaussian_k,
+    "gaussiankSA": gaussian_k_sa,
+    "topkSA": topk_sa,
+    # Script alias used by the reference job files (e.g. lstm_topkdsa.sh).
+    "topkDSA": topk_sa,
+    "oktopk": oktopk,
+}
+
+
+def get_algorithm(name: str, warmup: bool = True):
+    """Look up an algorithm; ``warmup=True`` wraps it with the dense warmup
+    the reference applies to every sparse run (VGG/allreducer.py:573)."""
+    try:
+        fn = ALGORITHMS[name]
+    except KeyError:
+        raise ValueError(
+            f"unknown compressor {name!r}; available: {sorted(ALGORITHMS)}")
+    if warmup and name != "dense":
+        fn = with_warmup(fn)
+    return fn

@@ -1,0 +1,74 @@
+"""Per-bucket algorithm state.
+
+The reference's AllReducer holds this state as instance dicts keyed by bucket
+name: allreduce counter, local/global thresholds, region boundaries/offsets
+(VGG/allreducer.py:240-244). Here it is an explicit pytree threaded through
+the jitted step — which makes it checkpointable (the reference never saves
+residuals or thresholds; resume silently resets error feedback, SURVEY.md
+§5.4) and makes every per-step quantity observable, including the analytic
+communication volume counters that reproduce the paper's <6k claim without
+reading XLA internals (SURVEY.md §7.3.7).
+"""
+
+from __future__ import annotations
+
+import flax.struct
+import jax.numpy as jnp
+
+from oktopk_tpu.config import OkTopkConfig
+
+
+@flax.struct.dataclass
+class SparseState:
+    step: jnp.ndarray                 # i32 — allreduce counter
+    local_threshold: jnp.ndarray      # f32 — predicted local sel. threshold
+    global_threshold: jnp.ndarray     # f32 — predicted global sel. threshold
+    boundaries: jnp.ndarray           # i32[P+1] — region offsets, [0..n]
+    residual: jnp.ndarray             # f32[n] — error-feedback buffer
+    # Analytic comm-volume accounting (elements sent by this worker):
+    volume_elems: jnp.ndarray         # f32 — cumulative over all steps
+    last_volume: jnp.ndarray          # f32 — last step only
+    # realised selected counts (observability; reference logs these under
+    # settings.PROFILING, VGG/allreducer.py:702-703)
+    last_local_count: jnp.ndarray     # i32
+    last_global_count: jnp.ndarray    # i32
+
+
+def init_state(cfg: OkTopkConfig, dtype=jnp.float32) -> SparseState:
+    """Fresh state: equal static region split (the reference starts from an
+    even split too, VGG/allreducer.py:240-244), zero thresholds (first step
+    always takes the exact-recompute branch since step % every == 0)."""
+    P, n = cfg.num_workers, cfg.n
+    base, rem = divmod(n, P)
+    sizes = jnp.asarray([base + (1 if i < rem else 0) for i in range(P)],
+                        jnp.int32)
+    boundaries = jnp.concatenate(
+        [jnp.zeros((1,), jnp.int32), jnp.cumsum(sizes)])
+    return SparseState(
+        step=jnp.asarray(0, jnp.int32),
+        local_threshold=jnp.asarray(0.0, dtype),
+        global_threshold=jnp.asarray(0.0, dtype),
+        boundaries=boundaries,
+        residual=jnp.zeros((n,), dtype),
+        volume_elems=jnp.asarray(0.0, jnp.float32),
+        last_volume=jnp.asarray(0.0, jnp.float32),
+        last_local_count=jnp.asarray(0, jnp.int32),
+        last_global_count=jnp.asarray(0, jnp.int32),
+    )
+
+
+def bump(state: SparseState, *, volume, local_count=None,
+         global_count=None, **updates) -> SparseState:
+    """Advance the step counter and record per-step accounting."""
+    vol = jnp.asarray(volume, jnp.float32)
+    kw = dict(
+        step=state.step + 1,
+        volume_elems=state.volume_elems + vol,
+        last_volume=vol,
+    )
+    if local_count is not None:
+        kw["last_local_count"] = jnp.asarray(local_count, jnp.int32)
+    if global_count is not None:
+        kw["last_global_count"] = jnp.asarray(global_count, jnp.int32)
+    kw.update(updates)
+    return state.replace(**kw)

@@ -1,0 +1,119 @@
+"""Split-allreduce baselines: topkSA ("topkDSA") and gaussiankSA.
+
+Reference: ``topkSA`` (VGG/allreducer.py:1153-1357) — oktopk's phase (a) with
+*static* equal regions instead of load-balanced repartitioning, plus a
+density-adaptive fallback to a dense gather when the reduced result is >= 2/3
+dense (:1318-1351); and ``gaussiankSA`` (VGG/allreducer.py:1503-1620) — the
+same split-exchange shape with the per-step Gaussian threshold (the
+reference implements the exchange as a ring reduce-scatter; one
+``all_to_all`` on fixed-capacity buffers is the TPU-native equivalent with
+the same volume).
+
+The dense fallback branch is a plain ``psum`` of the disjoint per-region
+partials — exactly the dense allgather of regions the reference falls back
+to, with volume 2n.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+from oktopk_tpu.collectives.state import SparseState, bump
+from oktopk_tpu.comm import all_gather, all_to_all, axis_rank, psum
+from oktopk_tpu.config import OkTopkConfig
+from oktopk_tpu.ops import (
+    gaussian_threshold,
+    k2threshold,
+    pack_by_region,
+    scatter_sparse,
+    select_by_threshold,
+)
+from oktopk_tpu.ops.residual import add_residual, update_residual_at_winners
+
+
+def _split_allreduce(acc, lt, state: SparseState, cfg: OkTopkConfig,
+                     axis_name: str, dense_fallback: bool):
+    """Shared body: threshold-select -> all_to_all into static regions ->
+    scatter-add -> gather phase (sparse allgather or dense-fallback psum)."""
+    P, n, k = cfg.num_workers, cfg.n, cfg.k
+    rank = axis_rank(axis_name)
+    boundaries = state.boundaries      # static equal split from init_state
+
+    mask = jnp.abs(acc) >= lt
+    local_count = jnp.sum(mask)
+    s_vals, s_idx, s_counts = pack_by_region(
+        acc, mask, boundaries, P, cfg.cap_pair)
+    r_vals = all_to_all(s_vals, axis_name)
+    r_idx = all_to_all(s_idx, axis_name)
+    reduced = scatter_sparse(n, r_vals, r_idx)
+
+    recv_count = jnp.sum(r_idx < n)
+    own_count = s_counts[rank]
+    vol_a = 2.0 * (local_count - own_count) + 2.0 * (recv_count - own_count)
+
+    nnz = jnp.sum(reduced != 0.0)
+    total_nnz = psum(nnz, axis_name)
+
+    cap_g = cfg.cap_local
+
+    def sparse_gather():
+        gvals, gidx, gcount = select_by_threshold(
+            reduced, jnp.asarray(1e-38, acc.dtype), cap_g)
+        gv = all_gather(gvals, axis_name)
+        gi = all_gather(gidx, axis_name)
+        result = scatter_sparse(n, gv, gi)
+        total = psum(gcount, axis_name)
+        vol = 2.0 * gcount + 2.0 * (total - gcount)
+        return result, vol
+
+    def dense_gather():
+        # Regions are disjoint, so psum of the partials is the dense gather
+        # the reference falls back to (VGG/allreducer.py:1318-1351).
+        return psum(reduced, axis_name), jnp.asarray(2.0 * n, jnp.float32)
+
+    if dense_fallback:
+        result, vol_b = lax.cond(
+            total_nnz >= cfg.sa_dense_fallback_ratio * n,
+            dense_gather, sparse_gather)
+    else:
+        result, vol_b = sparse_gather()
+
+    result = result / P
+    winner_mask = result != 0.0
+    residual = update_residual_at_winners(acc, winner_mask)
+    return result, residual, vol_a + vol_b, local_count, total_nnz
+
+
+def topk_sa(grad: jnp.ndarray, state: SparseState, cfg: OkTopkConfig,
+            axis_name: str = "data"):
+    """topkSA / "topkDSA": predicted top-k threshold + static split-allreduce
+    (reference VGG/allreducer.py:1153-1357)."""
+    k = cfg.k
+    acc = add_residual(grad, state.residual)
+    abs_acc = jnp.abs(acc)
+    lt = lax.cond(state.step % cfg.local_recompute_every == 0,
+                  lambda: k2threshold(abs_acc, k).astype(acc.dtype),
+                  lambda: state.local_threshold)
+    result, residual, vol, lc, gc = _split_allreduce(
+        acc, lt, state, cfg, axis_name, dense_fallback=True)
+    grow = lc > cfg.band_hi * k
+    shrink = lc < cfg.band_lo * k
+    lt_next = lt * jnp.where(grow, cfg.local_adapt_scale,
+                             jnp.where(shrink, 1.0 / cfg.local_adapt_scale, 1.0))
+    return result, bump(state, volume=vol, residual=residual,
+                        local_threshold=lt_next,
+                        local_count=lc, global_count=gc)
+
+
+def gaussian_k_sa(grad: jnp.ndarray, state: SparseState, cfg: OkTopkConfig,
+                  axis_name: str = "data"):
+    """gaussiankSA: Gaussian per-step threshold + static split-allreduce
+    (reference VGG/allreducer.py:1503-1620)."""
+    acc = add_residual(grad, state.residual)
+    t = gaussian_threshold(acc, cfg.k, cfg.gaussian_refine_iters).astype(acc.dtype)
+    result, residual, vol, lc, gc = _split_allreduce(
+        acc, t, state, cfg, axis_name, dense_fallback=False)
+    return result, bump(state, volume=vol, residual=residual,
+                        local_threshold=t,
+                        local_count=lc, global_count=gc)

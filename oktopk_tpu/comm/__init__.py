@@ -1,0 +1,32 @@
+"""Mesh + collective substrate (replaces the reference's mpi4py layer).
+
+Primitive census of the reference (SURVEY.md §5.8, reference
+VGG/allreducer.py:638,708,750-754,807,819,1031) and the TPU-native mapping
+implemented here:
+
+- ``MPI.Allreduce``            -> :func:`psum` / :func:`pmean`
+- ``MPI.Allgather``            -> :func:`all_gather`
+- ``MPI.Allgatherv``           -> :func:`all_gather` over fixed-capacity
+                                   (values, indices, count) triples
+- ``MPI.Alltoall``             -> :func:`all_to_all`
+- tagged ``Isend/Irecv`` rounds-> :func:`ppermute_shift` ring rounds /
+                                   one :func:`all_to_all`
+- ``MPI.Bcast`` of model state -> parameter replication by sharding spec
+                                   (free under pjit; no code needed)
+"""
+
+from oktopk_tpu.comm.mesh import (  # noqa: F401
+    DATA_AXIS,
+    get_mesh,
+    local_mesh,
+)
+from oktopk_tpu.comm.primitives import (  # noqa: F401
+    all_gather,
+    all_to_all,
+    axis_rank,
+    axis_size,
+    pmean,
+    ppermute_shift,
+    psum,
+    psum_scatter,
+)

@@ -1,0 +1,45 @@
+"""Device mesh construction.
+
+The reference's "topology" is a flat MPI communicator sized by SLURM
+(reference BERT/bert/main_bert.py:159-203 discovers ranks from SLURM_* env
+vars). On TPU the analogue is a named-axis ``jax.sharding.Mesh`` over
+``jax.devices()``; rank discovery, rendezvous and broadcast all disappear into
+the sharding annotations.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+DATA_AXIS = "data"
+MODEL_AXIS = "model"
+PIPE_AXIS = "pipe"
+SEQ_AXIS = "seq"
+
+
+def get_mesh(
+    shape: Optional[Tuple[int, ...]] = None,
+    axis_names: Sequence[str] = (DATA_AXIS,),
+    devices=None,
+) -> Mesh:
+    """Build a mesh over the available devices.
+
+    ``shape=None`` puts every device on the first axis (pure data
+    parallelism — the reference's only real mode, SURVEY.md §2.3).
+    """
+    if devices is None:
+        devices = jax.devices()
+    if shape is None:
+        shape = (len(devices),) + (1,) * (len(axis_names) - 1)
+    dev_array = np.asarray(devices).reshape(shape)
+    return Mesh(dev_array, tuple(axis_names))
+
+
+def local_mesh(num: int = 1, axis_names: Sequence[str] = (DATA_AXIS,)) -> Mesh:
+    """Mesh over the first ``num`` devices (single-chip testing)."""
+    return get_mesh((num,) + (1,) * (len(axis_names) - 1), axis_names,
+                    devices=jax.devices()[:num])

@@ -1,0 +1,82 @@
+"""Typed collective wrappers, usable inside ``shard_map`` / pjit.
+
+These are thin on purpose: XLA already implements the collectives over
+ICI/DCN; the value here is (a) one place that names the mapping from the
+reference's MPI verbs (SURVEY.md §5.8), (b) a stable seam for tests and for
+analytic communication-volume accounting (``collectives.state``), and (c) a
+place to swap in Pallas remote-DMA kernels later without touching algorithms.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def axis_size(axis_name: str):
+    """World size along an axis (reference: comm.size)."""
+    return lax.axis_size(axis_name)
+
+
+def axis_rank(axis_name: str):
+    """This shard's index along an axis (reference: comm.rank)."""
+    return lax.axis_index(axis_name)
+
+
+def psum(x, axis_name: str):
+    """Dense allreduce-sum (reference MPI.Allreduce, VGG/allreducer.py:178)."""
+    return lax.psum(x, axis_name)
+
+
+def pmean(x, axis_name: str):
+    """Allreduce-mean (the reference divides by size after Allreduce)."""
+    return lax.pmean(x, axis_name)
+
+
+def all_gather(x, axis_name: str, *, axis: int = 0, tiled: bool = False):
+    """Fixed-size allgather (reference MPI.Allgather, VGG/allreducer.py:807).
+
+    The reference's variable-size ``Allgatherv`` (VGG/allreducer.py:819,1031)
+    has no XLA analogue; callers gather fixed-capacity (values, indices,
+    count) triples instead — see ``ops.select.select_by_threshold``.
+    """
+    return lax.all_gather(x, axis_name, axis=axis, tiled=tiled)
+
+
+def all_to_all(x, axis_name: str, *, split_axis: int = 0, concat_axis: int = 0,
+               tiled: bool = False):
+    """All-to-all (replaces both the reference's size-transpose
+    MPI.Alltoall at VGG/allreducer.py:708 and the throttled tagged
+    Isend/Irecv pairwise exchange at VGG/allreducer.py:740-794: with
+    fixed-capacity buffers the size exchange is unnecessary and the pairwise
+    data exchange is exactly one all_to_all on a [P, cap] buffer)."""
+    return lax.all_to_all(x, axis_name, split_axis=split_axis,
+                          concat_axis=concat_axis, tiled=tiled)
+
+
+def psum_scatter(x, axis_name: str, *, scatter_dimension: int = 0,
+                 tiled: bool = True):
+    """Reduce-scatter (the dense-masked collapse of oktopk phase (a) when
+    density permits; SURVEY.md §5.8)."""
+    return lax.psum_scatter(x, axis_name,
+                            scatter_dimension=scatter_dimension, tiled=tiled)
+
+
+def ppermute_shift(x, axis_name: str, shift: int = 1):
+    """Ring shift by ``shift`` positions (reference's rotated dst/src
+    schedule, VGG/allreducer.py:246-251, is exactly P-1 such shifts; also the
+    building block for gtopk's tree exchange and ring attention)."""
+    n = lax.axis_size(axis_name)
+    perm = [(i, (i + shift) % n) for i in range(n)]
+    return lax.ppermute(x, axis_name, perm)
+
+
+def ppermute_pair(x, axis_name: str, distance: int):
+    """Butterfly exchange with the partner at XOR ``distance`` (reference
+    gtopk's recursive-halving tree, VGG/allreducer.py:76-172, expressed as a
+    symmetric exchange so every rank ends with the same merged result and the
+    final Bcast at VGG/allreducer.py:162 is unnecessary)."""
+    n = lax.axis_size(axis_name)
+    perm = [(i, i ^ distance) for i in range(n)]
+    return lax.ppermute(x, axis_name, perm)

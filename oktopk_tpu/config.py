@@ -1,0 +1,148 @@
+"""Typed configuration tree.
+
+The reference scatters its real tuning surface across three layers (shell conf
+files, argparse, and magic constants in code — see e.g. THRESHOLD=640MiB at
+reference VGG/allreducer.py:27, recompute intervals at VGG/allreducer.py:577-579
+vs BERT/bert/allreducer.py:359-361, threshold scales at VGG/allreducer.py:209-211
+vs BERT/bert/allreducer.py:188-190, dense warmup at VGG/allreducer.py:573).
+Here every such constant is a field on one frozen dataclass so it is visible,
+testable, and hashable (usable as a static arg under jit).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+
+@dataclasses.dataclass(frozen=True)
+class OkTopkConfig:
+    """Static configuration for the sparse allreduce algorithms.
+
+    All fields are Python scalars so the config is hashable and can be closed
+    over by jitted functions; anything that changes per-step lives in
+    ``collectives.state.SparseState`` instead.
+    """
+
+    # Problem geometry (static under XLA: shapes must be known at trace time).
+    n: int = 0                 # flattened gradient length
+    num_workers: int = 1       # data-parallel world size (mesh axis length)
+    density: float = 0.02      # target k = ceil(density * n); reference VGG run uses 0.02
+
+    # Cadences (reference VGG/allreducer.py:577-579; BERT uses 128/128/64).
+    local_recompute_every: int = 32    # exact local top-k threshold recompute
+    global_recompute_every: int = 32   # exact global top-k threshold recompute
+    repartition_every: int = 64        # load-balanced region repartition
+
+    # Dense warmup (reference VGG/allreducer.py:573 = 512; LSTM 128; BERT 0).
+    warmup_steps: int = 512
+
+    # Multiplicative threshold adaptation (reference VGG/allreducer.py:209-211
+    # uses 1.012/1.008; BERT/bert/allreducer.py:188-190 uses 1.025/1.036).
+    local_adapt_scale: float = 1.012
+    global_adapt_scale: float = 1.008
+
+    # Control band for the per-step selected count, as multiples of k
+    # (reference grows/shrinks the threshold toward [2k/3, 5k/4],
+    # VGG/allreducer.py:696-699).
+    band_lo: float = 2.0 / 3.0
+    band_hi: float = 5.0 / 4.0
+
+    # Fixed-capacity factors. XLA has no ragged collectives (no Allgatherv /
+    # size Alltoall), so every variable-length exchange in the reference
+    # becomes a fixed-capacity (values, indices, count) buffer here.
+    # Capacities are multiples of the expected count; the reference's own
+    # threshold feedback keeps realised counts inside the band above, so a
+    # modest headroom factor suffices (SURVEY.md §7.3.1).
+    cap_pair_factor: float = 2.0    # per (src -> dst-region) buffer, of k/P
+    cap_gather_factor: float = 2.5  # per-region allgather buffer, of k/P
+
+    # Gaussian threshold estimation (reference compression.py:238-259 refines a
+    # scipy ppf estimate in a bounded loop; we binary-search, see ops/gaussian).
+    gaussian_refine_iters: int = 16
+    sigma_scale: float = 2.5        # reference VGG/vgg16_oktopk.sh:28
+
+    # topkSA density-adaptive fallback: switch to dense allgather when the
+    # reduced result is >= this dense (reference VGG/allreducer.py:1318-1351).
+    sa_dense_fallback_ratio: float = 2.0 / 3.0
+
+    @property
+    def k(self) -> int:
+        """Target number of selected elements (k = density * n)."""
+        return max(1, int(self.density * self.n))
+
+    @property
+    def k_region(self) -> int:
+        """Expected per-region winner count (k / P)."""
+        return max(1, self.k // max(1, self.num_workers))
+
+    @property
+    def cap_pair(self) -> int:
+        """Capacity of each (worker -> region) exchange buffer."""
+        cap = int(self.cap_pair_factor * self.k / max(1, self.num_workers)) + 8
+        return min(self.n, cap)
+
+    @property
+    def cap_gather(self) -> int:
+        """Capacity of each per-region allgather buffer (phase b)."""
+        cap = int(self.cap_gather_factor * self.k / max(1, self.num_workers)) + 8
+        return min(self.n, cap)
+
+    @property
+    def cap_local(self) -> int:
+        """Capacity for whole-vector local selections (topkAopt / gaussiank)."""
+        return min(self.n, int(self.cap_gather_factor * self.k) + 8)
+
+    def replace(self, **kw) -> "OkTopkConfig":
+        return dataclasses.replace(self, **kw)
+
+
+@dataclasses.dataclass(frozen=True)
+class CommConfig:
+    """Mesh geometry. The reference's world is a flat MPI communicator
+    (MPI.COMM_WORLD); ours is a named-axis device mesh. ``data`` is the
+    data-parallel axis (maps to the reference's rank space); ``model`` /
+    ``pipe`` / ``seq`` are TPU-side extensions."""
+
+    data_axis: str = "data"
+    model_axis: str = "model"
+    pipe_axis: str = "pipe"
+    seq_axis: str = "seq"
+    mesh_shape: Tuple[int, ...] = (1,)
+    axis_names: Tuple[str, ...] = ("data",)
+
+
+@dataclasses.dataclass(frozen=True)
+class TrainConfig:
+    """Trainer configuration (reference main_trainer.py argparse surface,
+    VGG/main_trainer.py:144-159 + exp_configs/*.conf)."""
+
+    dnn: str = "vgg16"
+    dataset: str = "cifar10"
+    batch_size: int = 16
+    lr: float = 0.1
+    momentum: float = 0.9
+    weight_decay: float = 5e-4
+    nesterov: bool = False
+    max_epochs: int = 161
+    nsteps_update: int = 1          # local gradient accumulation steps
+    compressor: str = "oktopk"
+    density: float = 0.02
+    sigma_scale: float = 2.5
+    seed: int = 0
+    num_workers: int = 1
+    # LSTM-only gradient clipping (reference LSTM/main_trainer.py:94-99).
+    grad_clip: Optional[float] = None
+    # BERT-style warmup-linear schedule knobs (transformers/optimization.py).
+    warmup_proportion: float = 0.01
+    total_steps: int = 0
+
+    def experiment_slug(self) -> str:
+        """Reference experiment naming convention
+        (VGG/main_trainer.py:163-166)."""
+        mode = "comp" if self.compressor != "dense" else "dense"
+        return (
+            f"allreduce-{mode}-{self.compressor}-gwarmup-dc1-model-mgwfbp"
+            f"-{self.dnn}-n{self.num_workers}-bs{self.batch_size}"
+            f"-lr{self.lr:.4f}-ns{self.nsteps_update}-ds{self.density}"
+        )

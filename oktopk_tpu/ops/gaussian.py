@@ -1,0 +1,57 @@
+"""Gaussian threshold estimation.
+
+The reference's GaussianCompressor (VGG/compression.py:167-260) estimates a
+selection threshold from a normal fit — ``gen_threshold_from_normal_distribution``
+computes the two-sided ppf of N(mean, std) (VGG/utils.py:136-138) — then
+refines it in a bounded loop of nonzero-counts until the realised count lands
+near k (VGG/compression.py:238-259).
+
+Here the ppf is closed-form via ``erfinv`` and the refinement is a fixed-trip
+bisection on |x| (bounded, branch-free — jit-friendly), which converges at
+least as tightly as the reference's multiplicative loop. Avoiding a full
+``top_k`` sort is the point of the Gaussian family: O(iters * n) compares on
+the VPU instead of an O(n log n) sort.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+
+def _normal_ppf(p, mean, std):
+    """Inverse CDF of N(mean, std) (scipy.stats.norm.ppf equivalent,
+    reference VGG/utils.py:136-138)."""
+    return mean + std * jnp.sqrt(2.0) * lax.erf_inv(2.0 * p - 1.0)
+
+
+def gaussian_threshold(x: jnp.ndarray, k: int, refine_iters: int = 16):
+    """Threshold t such that count(|x| >= t) ~= k, without sorting.
+
+    Initial estimate from the normal fit (two-sided), then ``refine_iters``
+    bisection steps between 0 and max|x|.
+    """
+    abs_x = jnp.abs(x)
+    mean = jnp.mean(x)
+    std = jnp.std(x) + 1e-12
+    ratio = jnp.clip(k / x.size, 1e-9, 0.5)
+    t0 = jnp.abs(_normal_ppf(1.0 - ratio / 2.0, mean, std))
+
+    hi0 = jnp.max(abs_x)
+    t0 = jnp.clip(t0, 0.0, hi0)
+
+    def body(_, carry):
+        lo, hi = carry
+        mid = 0.5 * (lo + hi)
+        count = jnp.sum(abs_x >= mid)
+        # too many selected -> raise threshold (move lo up)
+        lo = jnp.where(count > k, mid, lo)
+        hi = jnp.where(count > k, hi, mid)
+        return lo, hi
+
+    # Seed the bracket around the ppf estimate: check which side it is on.
+    count0 = jnp.sum(abs_x >= t0)
+    lo = jnp.where(count0 > k, t0, 0.0)
+    hi = jnp.where(count0 > k, hi0, t0)
+    lo, hi = lax.fori_loop(0, refine_iters, body, (lo, hi))
+    return 0.5 * (lo + hi)

@@ -1,0 +1,39 @@
+"""Error-feedback residual transforms.
+
+The reference keeps residuals in class-attribute dicts keyed by tensor name
+(VGG/compression.py:28,170) and mutates them in place. Here they are explicit
+arrays threaded through the algorithm state, with each algorithm's exact
+semantics preserved (SURVEY.md §7.3.4):
+
+- oktopk zeroes the residual only at indices that made the *global* result
+  (VGG/allreducer.py:1051-1052 via compression.py:467-471);
+- topkA-style compressors zero at the *local* selection
+  (VGG/compression.py:343);
+- the adaptive path adds everything back and re-subtracts what was sent
+  (add2residual, VGG/compression.py:384-404) — equivalent to the masked forms
+  below on the accumulated tensor.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def add_residual(grad: jnp.ndarray, residual: jnp.ndarray) -> jnp.ndarray:
+    """acc = grad + residual (the compensation add every compressor starts
+    with, reference VGG/compression.py:90,151-160)."""
+    return grad + residual
+
+
+def update_residual_at_winners(acc: jnp.ndarray,
+                               winner_mask: jnp.ndarray) -> jnp.ndarray:
+    """oktopk semantics: keep acc as residual except at global winners
+    (reference VGG/allreducer.py:1051-1052)."""
+    return jnp.where(winner_mask, 0.0, acc)
+
+
+def update_residual_at_selection(acc: jnp.ndarray,
+                                 selected_mask: jnp.ndarray) -> jnp.ndarray:
+    """topkA semantics: residual keeps everything not locally selected
+    (reference VGG/compression.py:343)."""
+    return jnp.where(selected_mask, 0.0, acc)

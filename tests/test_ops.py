@@ -1,0 +1,171 @@
+"""Unit tests for the functional compression kernels (ops/).
+
+The reference has no unit tests for compression.py (SURVEY.md §4); these are
+the pure-function tests its design made impossible.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from oktopk_tpu.ops import (
+    exact_topk,
+    ratio2threshold,
+    k2threshold,
+    select_by_threshold,
+    count_by_threshold,
+    scatter_sparse,
+    pack_by_region,
+    gaussian_threshold,
+    add_residual,
+    update_residual_at_winners,
+    update_residual_at_selection,
+)
+from oktopk_tpu.ops.select import region_mask
+
+
+class TestTopK:
+    def test_exact_topk_matches_numpy(self, rng):
+        x = jnp.asarray(rng.randn(1000).astype(np.float32))
+        vals, idx = jax.jit(lambda x: exact_topk(x, 50))(x)
+        ref_idx = np.argsort(-np.abs(np.asarray(x)))[:50]
+        assert set(np.asarray(idx).tolist()) == set(ref_idx.tolist())
+        np.testing.assert_allclose(np.asarray(x)[np.asarray(idx)], vals)
+
+    def test_k2threshold(self, rng):
+        x = jnp.abs(jnp.asarray(rng.randn(512).astype(np.float32)))
+        t = k2threshold(x, 32)
+        assert int(jnp.sum(x >= t)) >= 32
+
+    def test_ratio2threshold_selects_density(self, rng):
+        x = jnp.asarray(rng.randn(10000).astype(np.float32))
+        t = ratio2threshold(x, 0.02)
+        count = int(jnp.sum(jnp.abs(x) >= t))
+        assert count >= 200  # ties can only add
+
+    def test_topk_signed_values(self):
+        x = jnp.asarray([0.1, -5.0, 0.2, 3.0], jnp.float32)
+        vals, idx = exact_topk(x, 2)
+        assert set(np.asarray(idx).tolist()) == {1, 3}
+        assert set(np.round(np.asarray(vals), 3).tolist()) == {-5.0, 3.0}
+
+
+class TestSelect:
+    def test_select_by_threshold_basic(self):
+        x = jnp.asarray([0.0, 2.0, -3.0, 0.5, 4.0], jnp.float32)
+        vals, idx, count = select_by_threshold(x, 1.0, cap=4)
+        assert int(count) == 3
+        np.testing.assert_array_equal(np.asarray(idx), [1, 2, 4, 5])  # 5 = sentinel
+        np.testing.assert_allclose(np.asarray(vals), [2.0, -3.0, 4.0, 0.0])
+
+    def test_select_overflow_drops_tail(self):
+        x = jnp.ones(10, jnp.float32)
+        vals, idx, count = select_by_threshold(x, 0.5, cap=4)
+        assert int(count) == 4
+        np.testing.assert_array_equal(np.asarray(idx), [0, 1, 2, 3])
+
+    def test_scatter_roundtrip(self, rng):
+        x = jnp.asarray(rng.randn(100).astype(np.float32))
+        t = 1.0
+        vals, idx, _ = select_by_threshold(x, t, cap=100)
+        dense = scatter_sparse(100, vals, idx)
+        expected = np.where(np.abs(np.asarray(x)) >= t, np.asarray(x), 0.0)
+        np.testing.assert_allclose(np.asarray(dense), expected)
+
+    def test_scatter_drops_sentinel(self):
+        vals = jnp.asarray([1.0, 9.0], jnp.float32)
+        idx = jnp.asarray([0, 5], jnp.int32)  # 5 == n -> dropped
+        dense = scatter_sparse(5, vals, idx)
+        np.testing.assert_allclose(np.asarray(dense), [1.0, 0, 0, 0, 0])
+
+    def test_count_by_threshold(self):
+        x = jnp.asarray([-2.0, 0.1, 2.0], jnp.float32)
+        assert int(count_by_threshold(x, 1.0)) == 2
+
+
+class TestPackByRegion:
+    def test_pack_partitions_by_boundary(self, rng):
+        n, P, cap = 64, 4, 32
+        x = jnp.asarray(rng.randn(n).astype(np.float32))
+        mask = jnp.abs(x) >= 0.5
+        boundaries = jnp.asarray([0, 16, 32, 48, 64], jnp.int32)
+        vals, idx, counts = pack_by_region(x, mask, boundaries, P, cap)
+        xa, ma = np.asarray(x), np.asarray(mask)
+        for r in range(P):
+            lo, hi = 16 * r, 16 * (r + 1)
+            want = [i for i in range(lo, hi) if ma[i]]
+            got = [i for i in np.asarray(idx[r]).tolist() if i < n]
+            assert got == want
+            assert int(counts[r]) == len(want)
+            got_vals = np.asarray(vals[r])[: len(want)]
+            np.testing.assert_allclose(got_vals, xa[want])
+
+    def test_pack_respects_cap(self):
+        n, P, cap = 16, 2, 3
+        x = jnp.ones(n, jnp.float32)
+        mask = jnp.ones(n, bool)
+        boundaries = jnp.asarray([0, 8, 16], jnp.int32)
+        vals, idx, counts = pack_by_region(x, mask, boundaries, P, cap)
+        np.testing.assert_array_equal(np.asarray(counts), [3, 3])
+        # lowest-index-first retention
+        np.testing.assert_array_equal(np.asarray(idx[0]), [0, 1, 2])
+        np.testing.assert_array_equal(np.asarray(idx[1]), [8, 9, 10])
+
+    def test_uneven_regions(self, rng):
+        n, P, cap = 40, 4, 40
+        x = jnp.asarray(rng.randn(n).astype(np.float32))
+        mask = jnp.ones(n, bool)
+        boundaries = jnp.asarray([0, 5, 25, 30, 40], jnp.int32)
+        vals, idx, counts = pack_by_region(x, mask, boundaries, P, cap)
+        np.testing.assert_array_equal(np.asarray(counts), [5, 20, 5, 10])
+        # rebuild must equal the original vector
+        rebuilt = scatter_sparse(n, vals, idx)
+        np.testing.assert_allclose(np.asarray(rebuilt), np.asarray(x), rtol=1e-6)
+
+    def test_empty_region(self):
+        n = 8
+        x = jnp.arange(1.0, 9.0, dtype=jnp.float32)
+        boundaries = jnp.asarray([0, 4, 4, 8, 8], jnp.int32)
+        vals, idx, counts = pack_by_region(x, jnp.ones(n, bool), boundaries, 4, 8)
+        np.testing.assert_array_equal(np.asarray(counts), [4, 0, 4, 0])
+
+    def test_region_mask(self):
+        boundaries = jnp.asarray([0, 3, 7, 10], jnp.int32)
+        m = region_mask(10, boundaries, jnp.asarray(1))
+        np.testing.assert_array_equal(
+            np.asarray(m), [False] * 3 + [True] * 4 + [False] * 3)
+
+
+class TestGaussian:
+    def test_threshold_close_to_target_count(self, rng):
+        x = jnp.asarray(rng.randn(100000).astype(np.float32))
+        k = 2000
+        t = jax.jit(lambda x: gaussian_threshold(x, k))(x)
+        count = int(jnp.sum(jnp.abs(x) >= t))
+        assert 0.7 * k <= count <= 1.3 * k
+
+    def test_threshold_on_nonnormal_data_still_brackets(self, rng):
+        x = jnp.asarray((rng.rand(50000) ** 4).astype(np.float32))
+        k = 500
+        t = gaussian_threshold(x, k)
+        count = int(jnp.sum(jnp.abs(x) >= t))
+        assert 0.5 * k <= count <= 2.0 * k
+
+
+class TestResidual:
+    def test_error_feedback_conservation(self, rng):
+        grad = jnp.asarray(rng.randn(100).astype(np.float32))
+        residual = jnp.asarray(rng.randn(100).astype(np.float32))
+        acc = add_residual(grad, residual)
+        sel = jnp.abs(acc) >= 1.0
+        new_res = update_residual_at_selection(acc, sel)
+        # sent + residual' == acc exactly (nothing lost)
+        sent = jnp.where(sel, acc, 0.0)
+        np.testing.assert_allclose(np.asarray(sent + new_res), np.asarray(acc))
+
+    def test_winner_update_keeps_losers(self):
+        acc = jnp.asarray([1.0, 2.0, 3.0], jnp.float32)
+        won = jnp.asarray([True, False, True])
+        np.testing.assert_allclose(
+            np.asarray(update_residual_at_winners(acc, won)), [0.0, 2.0, 0.0])
