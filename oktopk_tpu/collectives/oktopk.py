@@ -35,6 +35,7 @@ from jax import lax
 
 from oktopk_tpu.collectives.state import SparseState, bump
 from oktopk_tpu.comm import all_gather, all_to_all, axis_rank, psum
+from oktopk_tpu.comm.primitives import pvary_tree
 from oktopk_tpu.config import OkTopkConfig
 from oktopk_tpu.ops import (
     exact_topk,
@@ -74,9 +75,12 @@ def _repartition(abs_acc, local_thresh, cfg: OkTopkConfig, axis_name: str):
     avg = psum(interior, axis_name) / P
     interior_i = jnp.clip(jnp.round(avg).astype(jnp.int32), 0, n)
     interior_i = jnp.sort(interior_i)
-    return jnp.concatenate([
+    out = jnp.concatenate([
         jnp.zeros((1,), jnp.int32), interior_i,
         jnp.full((1,), n, jnp.int32)])
+    # psum output is replication-invariant; the carried boundaries are
+    # per-shard ("varying") under shard_map's VMA tracking — align them.
+    return lax.pvary(out, (axis_name,))
 
 
 def oktopk(grad: jnp.ndarray, state: SparseState, cfg: OkTopkConfig,
@@ -86,15 +90,23 @@ def oktopk(grad: jnp.ndarray, state: SparseState, cfg: OkTopkConfig,
     acc = add_residual(grad, state.residual)
     abs_acc = jnp.abs(acc)
 
+    # The reference's warmup length is a multiple of the recompute cadence
+    # (512 % 32 == 0, VGG/allreducer.py:573,577) so its first sparse step
+    # always recomputes exactly; we make that explicit so any warmup length
+    # is safe (predicted thresholds start at 0 and would select everything).
+    first_sparse = state.step == cfg.warmup_steps
+    recompute_local = (state.step % cfg.local_recompute_every == 0) | first_sparse
+    recompute_global = (state.step % cfg.global_recompute_every == 0) | first_sparse
+
     # ---- local threshold: exact every local_recompute_every, else predicted
     # (reference VGG/allreducer.py:593 vs :696-699).
-    lt = lax.cond(state.step % cfg.local_recompute_every == 0,
+    lt = lax.cond(recompute_local,
                   lambda: k2threshold(abs_acc, k).astype(acc.dtype),
                   lambda: state.local_threshold)
 
     # ---- region repartition every repartition_every steps (reference :626-654).
     boundaries = lax.cond(
-        state.step % cfg.repartition_every == 0,
+        (state.step % cfg.repartition_every == 0) | first_sparse,
         lambda: _repartition(abs_acc, lt, cfg, axis_name),
         lambda: state.boundaries)
 
@@ -107,9 +119,13 @@ def oktopk(grad: jnp.ndarray, state: SparseState, cfg: OkTopkConfig,
     r_idx = all_to_all(s_idx, axis_name)
     reduced = scatter_sparse(n, r_vals, r_idx)  # nonzero only in own region
 
+    # Wire volume: the capped buffers bound what is actually sent (elements
+    # beyond cap stay in the residual) — unlike the reference, whose MPI
+    # sends are unbounded when counts drift above band between recomputes.
+    sent_count = jnp.sum(s_counts)
     recv_count = jnp.sum(r_idx < n)
     own_count = s_counts[rank]
-    vol_a = 2.0 * (local_count - own_count) + 2.0 * (recv_count - own_count)
+    vol_a = 2.0 * (sent_count - own_count) + 2.0 * (recv_count - own_count)
 
     # threshold feedback for the next step
     lt_next = _adapt(lt, local_count, k, cfg.local_adapt_scale,
@@ -133,8 +149,8 @@ def oktopk(grad: jnp.ndarray, state: SparseState, cfg: OkTopkConfig,
         result = scatter_sparse(n, jnp.where(keep, gv, 0.0),
                                 jnp.where(keep, gi, n))
         g_count = jnp.sum(keep)
-        vol = 2.0 * k_cand + 2.0 * k_cand * (P - 1)
-        return result, gt, g_count, vol
+        vol = jnp.asarray(2.0 * k_cand + 2.0 * k_cand * (P - 1), jnp.float32)
+        return pvary_tree((result, gt, g_count, vol), axis_name)
 
     def predicted_branch():
         # Otherwise: threshold-select own region, fixed-capacity allgather,
@@ -148,11 +164,10 @@ def oktopk(grad: jnp.ndarray, state: SparseState, cfg: OkTopkConfig,
         gt_next = _adapt(state.global_threshold, total_g, k,
                          cfg.global_adapt_scale, cfg.band_lo, cfg.band_hi)
         vol = 2.0 * gcount + 2.0 * (total_g - gcount)
-        return result, gt_next, total_g, vol
+        return pvary_tree((result, gt_next, total_g, vol), axis_name)
 
     result, gt_next, g_count, vol_b = lax.cond(
-        state.step % cfg.global_recompute_every == 0,
-        exact_branch, predicted_branch)
+        recompute_global, exact_branch, predicted_branch)
 
     result = result / P
 

@@ -77,7 +77,9 @@ def topk_a_opt(grad: jnp.ndarray, state: SparseState, cfg: OkTopkConfig,
     acc = add_residual(grad, state.residual)
     abs_acc = jnp.abs(acc)
 
-    lt = lax.cond(state.step % cfg.local_recompute_every == 0,
+    recompute = ((state.step % cfg.local_recompute_every == 0)
+                 | (state.step == cfg.warmup_steps))  # see oktopk.py
+    lt = lax.cond(recompute,
                   lambda: k2threshold(abs_acc, k).astype(acc.dtype),
                   lambda: state.local_threshold)
 

@@ -21,14 +21,15 @@ from jax import lax
 
 from oktopk_tpu.collectives.state import SparseState, bump
 from oktopk_tpu.comm import all_gather, all_to_all, axis_rank, psum
+from oktopk_tpu.comm.primitives import pvary_tree
 from oktopk_tpu.config import OkTopkConfig
 from oktopk_tpu.ops import (
     gaussian_threshold,
     k2threshold,
     pack_by_region,
     scatter_sparse,
-    select_by_threshold,
 )
+from oktopk_tpu.ops.select import select_nonzero
 from oktopk_tpu.ops.residual import add_residual, update_residual_at_winners
 
 
@@ -48,9 +49,10 @@ def _split_allreduce(acc, lt, state: SparseState, cfg: OkTopkConfig,
     r_idx = all_to_all(s_idx, axis_name)
     reduced = scatter_sparse(n, r_vals, r_idx)
 
+    sent_count = jnp.sum(s_counts)   # capped wire volume (see oktopk.py)
     recv_count = jnp.sum(r_idx < n)
     own_count = s_counts[rank]
-    vol_a = 2.0 * (local_count - own_count) + 2.0 * (recv_count - own_count)
+    vol_a = 2.0 * (sent_count - own_count) + 2.0 * (recv_count - own_count)
 
     nnz = jnp.sum(reduced != 0.0)
     total_nnz = psum(nnz, axis_name)
@@ -58,19 +60,20 @@ def _split_allreduce(acc, lt, state: SparseState, cfg: OkTopkConfig,
     cap_g = cfg.cap_local
 
     def sparse_gather():
-        gvals, gidx, gcount = select_by_threshold(
-            reduced, jnp.asarray(1e-38, acc.dtype), cap_g)
+        gvals, gidx, gcount = select_nonzero(reduced, cap_g)
         gv = all_gather(gvals, axis_name)
         gi = all_gather(gidx, axis_name)
         result = scatter_sparse(n, gv, gi)
         total = psum(gcount, axis_name)
         vol = 2.0 * gcount + 2.0 * (total - gcount)
-        return result, vol
+        return pvary_tree((result, vol), axis_name)
 
     def dense_gather():
         # Regions are disjoint, so psum of the partials is the dense gather
         # the reference falls back to (VGG/allreducer.py:1318-1351).
-        return psum(reduced, axis_name), jnp.asarray(2.0 * n, jnp.float32)
+        return pvary_tree(
+            (psum(reduced, axis_name), jnp.asarray(2.0 * n, jnp.float32)),
+            axis_name)
 
     if dense_fallback:
         result, vol_b = lax.cond(
@@ -92,7 +95,9 @@ def topk_sa(grad: jnp.ndarray, state: SparseState, cfg: OkTopkConfig,
     k = cfg.k
     acc = add_residual(grad, state.residual)
     abs_acc = jnp.abs(acc)
-    lt = lax.cond(state.step % cfg.local_recompute_every == 0,
+    recompute = ((state.step % cfg.local_recompute_every == 0)
+                 | (state.step == cfg.warmup_steps))  # see oktopk.py
+    lt = lax.cond(recompute,
                   lambda: k2threshold(abs_acc, k).astype(acc.dtype),
                   lambda: state.local_threshold)
     result, residual, vol, lc, gc = _split_allreduce(

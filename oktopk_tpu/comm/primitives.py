@@ -72,6 +72,24 @@ def ppermute_shift(x, axis_name: str, shift: int = 1):
     return lax.ppermute(x, axis_name, perm)
 
 
+def pvary_tree(tree, axis_name: str):
+    """Mark every leaf as per-shard "varying" under shard_map's VMA tracking.
+
+    ``lax.cond`` branches must agree on varying-ness; branches that mix
+    psum/constant (invariant) leaves with per-shard leaves use this to align
+    (see docs.jax.dev shard_map notebook, VMA section).
+    """
+    def _pvary(x):
+        x = jnp.asarray(x)
+        try:
+            already = axis_name in jax.typeof(x).vma
+        except Exception:
+            already = False
+        return x if already else lax.pvary(x, (axis_name,))
+
+    return jax.tree.map(_pvary, tree)
+
+
 def ppermute_pair(x, axis_name: str, distance: int):
     """Butterfly exchange with the partner at XOR ``distance`` (reference
     gtopk's recursive-halving tree, VGG/allreducer.py:76-172, expressed as a

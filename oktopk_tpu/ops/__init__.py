@@ -18,6 +18,8 @@ from oktopk_tpu.ops.select import (  # noqa: F401
     count_by_threshold,
     scatter_sparse,
     select_by_threshold,
+    select_mask,
+    select_nonzero,
     pack_by_region,
 )
 from oktopk_tpu.ops.gaussian import gaussian_threshold  # noqa: F401

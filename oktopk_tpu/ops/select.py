@@ -39,16 +39,31 @@ def select_by_threshold(x: jnp.ndarray, thresh, cap: int):
     than ``cap`` elements pass the threshold the tail is dropped (and should
     remain in the caller's residual).
     """
+    return select_mask(x, jnp.abs(x) >= thresh, cap)
+
+
+def select_mask(x: jnp.ndarray, mask: jnp.ndarray, cap: int):
+    """Pack elements where ``mask`` is True into a fixed-capacity triple
+    (same layout as :func:`select_by_threshold`)."""
     n = x.size
-    mask = jnp.abs(x) >= thresh
-    pos = jnp.cumsum(mask) - 1                       # dense rank of each hit
-    pos = jnp.where(mask & (pos < cap), pos, cap)    # misses/overflow -> drop
+    pos = jnp.cumsum(mask) - 1
+    pos = jnp.where(mask & (pos < cap), pos, cap)
     values = jnp.zeros((cap,), x.dtype).at[pos].set(
         jnp.where(mask, x, 0), mode="drop")
     indices = jnp.full((cap,), n, jnp.int32).at[pos].set(
         jnp.arange(n, dtype=jnp.int32), mode="drop")
     count = jnp.minimum(jnp.sum(mask), cap)
     return values, indices, count
+
+
+def select_nonzero(x: jnp.ndarray, cap: int):
+    """Pack the nonzeros of ``x`` (the reference's plain nonzero extract of
+    its reduced region before Allgatherv, VGG/allreducer.py:1326).
+
+    Do NOT emulate this with a tiny threshold: subnormal thresholds flush to
+    zero on TPU/XLA and select everything.
+    """
+    return select_mask(x, x != 0.0, cap)
 
 
 def scatter_sparse(n: int, values: jnp.ndarray, indices: jnp.ndarray,

@@ -1,0 +1,23 @@
+"""Distributed optimizers (reference L3, SURVEY.md §1).
+
+The reference wraps torch optimizers: ``_DistributedOptimizer`` dynamically
+subclasses the user's SGD and feeds a background allreducer thread
+(VGG/distributed_optimizer.py:21-207); BERT's ``BertAdam`` flattens all grads
+and calls the allreducer synchronously inside ``step()``
+(BERT/bert/transformers/optimization.py:68-224).
+
+Here optimizers are pure ``(grads, state, params) -> (updates, state)``
+transforms (optax-compatible protocol, so optax optimizers drop in too), and
+the "distributed" part — flatten grads, run the sparse collective, unflatten,
+update — is one jitted train step (optim/distributed.py). There are no
+threads: compute/communication overlap is XLA's async-collective scheduling,
+not a background Python thread (SURVEY.md §7.1.4).
+"""
+
+from oktopk_tpu.optim.sgd import sgd  # noqa: F401
+from oktopk_tpu.optim.bert_adam import bert_adam  # noqa: F401
+from oktopk_tpu.optim.schedules import SCHEDULES, warmup_linear  # noqa: F401
+from oktopk_tpu.optim.distributed import (  # noqa: F401
+    DistTrainState,
+    build_sparse_grad_step,
+)

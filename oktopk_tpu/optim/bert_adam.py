@@ -1,0 +1,81 @@
+"""BertAdam: Adam without bias correction + warmup schedule + grad clipping.
+
+Reference: ``BertAdam`` (BERT/bert/transformers/optimization.py:68-224) —
+the BERT pretraining optimizer whose ``step()`` also hosts the sparse
+allreduce (flatten grads -> allreducer.run -> split -> Adam update,
+:145-224). Here the allreduce lives in the train step
+(optim/distributed.py); this module is the pure parameter update:
+
+    m = b1*m + (1-b1)*g ;  v = b2*v + (1-b2)*g^2
+    update = m / (sqrt(v) + eps) + weight_decay * p
+    p -= lr * schedule(step/t_total, warmup) * update
+
+(no bias correction — BertAdam's signature quirk, reference :188-205), with
+global-norm gradient clipping to ``max_grad_norm`` (reference :183).
+"""
+
+from __future__ import annotations
+
+import flax.struct
+import jax
+import jax.numpy as jnp
+
+from oktopk_tpu.optim.schedules import SCHEDULES
+
+
+@flax.struct.dataclass
+class BertAdamState:
+    step: jnp.ndarray
+    m: any
+    v: any
+
+
+class BertAdam:
+    def __init__(self, lr: float = 2e-4, warmup: float = 0.01,
+                 t_total: int = -1, schedule: str = "warmup_linear",
+                 b1: float = 0.9, b2: float = 0.999, eps: float = 1e-6,
+                 weight_decay: float = 0.01, max_grad_norm: float = 1.0):
+        self.lr, self.warmup, self.t_total = lr, warmup, t_total
+        self.schedule_fn = SCHEDULES[schedule]
+        self.b1, self.b2, self.eps = b1, b2, eps
+        self.weight_decay = weight_decay
+        self.max_grad_norm = max_grad_norm
+
+    def init(self, params) -> BertAdamState:
+        return BertAdamState(
+            step=jnp.asarray(0, jnp.int32),
+            m=jax.tree.map(jnp.zeros_like, params),
+            v=jax.tree.map(jnp.zeros_like, params))
+
+    def lr_t(self, step):
+        if self.t_total > 0:
+            x = step.astype(jnp.float32) / self.t_total
+            return self.lr * self.schedule_fn(x, self.warmup)
+        return jnp.asarray(self.lr, jnp.float32)
+
+    def update(self, grads, state: BertAdamState, params=None):
+        if self.max_grad_norm > 0:
+            leaves = jax.tree.leaves(grads)
+            gnorm = jnp.sqrt(sum(jnp.sum(g.astype(jnp.float32) ** 2)
+                                 for g in leaves))
+            scale = jnp.minimum(1.0, self.max_grad_norm / (gnorm + 1e-12))
+            grads = jax.tree.map(lambda g: g * scale, grads)
+
+        b1, b2 = self.b1, self.b2
+        m = jax.tree.map(lambda m_, g: b1 * m_ + (1 - b1) * g, state.m, grads)
+        v = jax.tree.map(lambda v_, g: b2 * v_ + (1 - b2) * g * g,
+                         state.v, grads)
+        lr_t = self.lr_t(state.step)
+
+        def upd(m_, v_, p):
+            u = m_ / (jnp.sqrt(v_) + self.eps)
+            if self.weight_decay > 0 and p is not None:
+                u = u + self.weight_decay * p
+            return -lr_t * u
+
+        updates = jax.tree.map(upd, m, v, params)
+        return updates, BertAdamState(step=state.step + 1, m=m, v=v)
+
+
+def bert_adam(**kw) -> BertAdam:
+    return BertAdam(**kw)

@@ -1,0 +1,44 @@
+"""LR schedules.
+
+- BertAdam's warmup schedules (reference
+  BERT/bert/transformers/optimization.py:41-58: warmup_cosine,
+  warmup_constant, warmup_linear over progress x = step / t_total).
+- The CNN multi-step decay the reference trainer applies
+  (VGG/dl_trainer.py:507-570).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def warmup_cosine(x, warmup=0.002):
+    return jnp.where(x < warmup, x / warmup,
+                     0.5 * (1.0 + jnp.cos(jnp.pi * x)))
+
+
+def warmup_constant(x, warmup=0.002):
+    return jnp.where(x < warmup, x / warmup, 1.0)
+
+
+def warmup_linear(x, warmup=0.002):
+    return jnp.where(x < warmup, x / warmup, jnp.maximum(1.0 - x, 0.0))
+
+
+SCHEDULES = {
+    "warmup_cosine": warmup_cosine,
+    "warmup_constant": warmup_constant,
+    "warmup_linear": warmup_linear,
+}
+
+
+def multistep_lr(base_lr: float, milestones, gamma: float = 0.1):
+    """Step decay at epoch milestones (reference VGG/dl_trainer.py:507-570
+    decays lr at fixed epoch boundaries)."""
+    ms = jnp.asarray(milestones)
+
+    def schedule(epoch):
+        drops = jnp.sum(epoch >= ms)
+        return base_lr * (gamma ** drops)
+
+    return schedule

@@ -1,0 +1,61 @@
+"""SGD with momentum / weight decay / nesterov.
+
+Exact semantics of the reference's custom ``_step``
+(VGG/distributed_optimizer.py:107-145), which reimplements torch SGD on the
+allreduced sparse gradients:
+
+    d_p = grad + weight_decay * p
+    buf = momentum * buf + d_p                  (dampening = 0)
+    d_p = d_p + momentum * buf   if nesterov else buf
+    p  -= lr * d_p
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Union
+
+import flax.struct
+import jax
+import jax.numpy as jnp
+
+
+@flax.struct.dataclass
+class SGDState:
+    step: jnp.ndarray
+    momentum_buf: any = flax.struct.field(default=None)
+
+
+class SGD:
+    def __init__(self, lr: Union[float, Callable], momentum: float = 0.9,
+                 weight_decay: float = 0.0, nesterov: bool = False):
+        self.lr = lr if callable(lr) else (lambda step: lr)
+        self.momentum = momentum
+        self.weight_decay = weight_decay
+        self.nesterov = nesterov
+
+    def init(self, params) -> SGDState:
+        buf = jax.tree.map(jnp.zeros_like, params) if self.momentum else None
+        return SGDState(step=jnp.asarray(0, jnp.int32), momentum_buf=buf)
+
+    def update(self, grads, state: SGDState, params=None):
+        lr = self.lr(state.step)
+        wd, m = self.weight_decay, self.momentum
+
+        if wd:
+            grads = jax.tree.map(lambda g, p: g + wd * p, grads, params)
+        if m:
+            buf = jax.tree.map(lambda b, g: m * b + g,
+                               state.momentum_buf, grads)
+            if self.nesterov:
+                d_p = jax.tree.map(lambda g, b: g + m * b, grads, buf)
+            else:
+                d_p = buf
+        else:
+            buf, d_p = state.momentum_buf, grads
+        updates = jax.tree.map(lambda d: -lr * d, d_p)
+        return updates, SGDState(step=state.step + 1, momentum_buf=buf)
+
+
+def sgd(lr, momentum: float = 0.9, weight_decay: float = 0.0,
+        nesterov: bool = False) -> SGD:
+    return SGD(lr, momentum, weight_decay, nesterov)

@@ -18,6 +18,11 @@ if _flag not in os.environ.get("XLA_FLAGS", ""):
 os.environ["JAX_PLATFORMS"] = "cpu"
 
 import jax  # noqa: E402
+
+# A site plugin may have force-selected a hardware backend via
+# jax.config.update at interpreter startup; env vars alone can't undo that,
+# but updating the config before first backend use can.
+jax.config.update("jax_platforms", "cpu")
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
