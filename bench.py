@@ -1,0 +1,135 @@
+"""Benchmark entry point.
+
+Prints ONE JSON line:
+    {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+Headline metric: Ok-Topk sparse-allreduce communication volume per worker per
+step (bytes), measured on a multi-worker mesh in the threshold-tracking
+regime, vs the dense-allreduce baseline (~2n elements/worker/step — the
+BASELINE.md "allreduce bytes/step vs dense" north star). ``vs_baseline`` is
+the reduction factor (dense bytes / oktopk bytes; higher is better; the
+paper's property is volume < 6k elements, reference README.md:2).
+
+Also measures (stderr, informational): the end-to-end VGG-16/CIFAR-10
+oktopk train-step time on the available accelerator.
+
+The volume measurement runs in a subprocess on a virtual 8-worker CPU mesh
+(collectives need multiple devices; the benchmark chip is single-device), the
+step-time measurement runs on the real accelerator in-process.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+BYTES_PER_ELEM = 4  # f32 scalars; indices are int32
+
+
+def volume_probe():
+    """Measure oktopk comm volume on an 8-worker virtual mesh (run in a
+    subprocess with a CPU backend)."""
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                               + " --xla_force_host_platform_device_count=8")
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+    import numpy as np
+
+    from oktopk_tpu.collectives.api import batched_init_state, \
+        build_allreduce_step
+    from oktopk_tpu.comm.mesh import get_mesh
+    from oktopk_tpu.config import OkTopkConfig
+
+    P, n = 8, 1 << 20
+    cfg = OkTopkConfig(n=n, num_workers=P, density=0.01, warmup_steps=0,
+                       local_recompute_every=1, global_recompute_every=4)
+    mesh = get_mesh((P,), ("data",))
+    step = build_allreduce_step("oktopk", cfg, mesh, warmup=False)
+    state = batched_init_state(cfg)
+    rng = np.random.RandomState(0)
+    base = rng.randn(P, n).astype(np.float32)
+    vols = []
+    for i in range(9):
+        grads = jnp.asarray(base + 0.3 * rng.randn(P, n).astype(np.float32))
+        _, state = step(grads, state)
+        if i % 4 != 0:   # steady-state predicted steps
+            vols.append(float(state.last_volume[0]))
+    out = {"n": n, "k": cfg.k, "mean_volume_elems": sum(vols) / len(vols),
+           "dense_volume_elems": 2.0 * n}
+    print("VOLUME_PROBE " + json.dumps(out))
+
+
+def step_time_probe():
+    """VGG-16/CIFAR oktopk train-step time on the available accelerator
+    (single-chip mesh: measures the compute+selection path)."""
+    import jax
+    import numpy as np
+
+    from oktopk_tpu.comm.mesh import get_mesh
+    from oktopk_tpu.config import TrainConfig
+    from oktopk_tpu.data.synthetic import synthetic_batch
+    from oktopk_tpu.train.trainer import Trainer
+
+    dev = jax.devices()[0]
+    mesh = get_mesh((1,), ("data",), devices=[dev])
+    cfg = TrainConfig(dnn="vgg16", dataset="cifar10", batch_size=16,
+                      lr=0.1, compressor="oktopk", density=0.02,
+                      num_workers=1)
+    trainer = Trainer(cfg, mesh=mesh, warmup=False)
+    rng = np.random.RandomState(0)
+    batch = synthetic_batch("vgg16", 16, rng)
+    m = trainer.train_step(batch)          # compile
+    jax.block_until_ready(m["loss"])
+    t0 = time.time()
+    iters = 20
+    for _ in range(iters):
+        m = trainer.train_step(batch)
+    jax.block_until_ready(m["loss"])
+    dt = (time.time() - t0) / iters
+    print(f"[bench] device={dev.platform} vgg16 oktopk step "
+          f"{dt * 1e3:.1f} ms  ({16 / dt:.1f} images/s/chip)",
+          file=sys.stderr)
+    return dt
+
+
+def main():
+    if "--volume-probe" in sys.argv:
+        volume_probe()
+        return
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.run(
+        [sys.executable, os.path.abspath(__file__), "--volume-probe"],
+        capture_output=True, text=True, env=env, cwd=here, timeout=1800)
+    probe = None
+    for line in proc.stdout.splitlines():
+        if line.startswith("VOLUME_PROBE "):
+            probe = json.loads(line[len("VOLUME_PROBE "):])
+    if probe is None:
+        print(proc.stdout, file=sys.stderr)
+        print(proc.stderr[-4000:], file=sys.stderr)
+        raise RuntimeError("volume probe failed")
+
+    try:
+        step_time_probe()
+    except Exception as e:  # informational only — never break the headline
+        print(f"[bench] step-time probe skipped: {e!r}", file=sys.stderr)
+
+    value = probe["mean_volume_elems"] * BYTES_PER_ELEM
+    dense = probe["dense_volume_elems"] * BYTES_PER_ELEM
+    print(json.dumps({
+        "metric": "oktopk_sparse_allreduce_volume_bytes_per_step",
+        "value": round(value, 1),
+        "unit": "bytes/step/worker",
+        "vs_baseline": round(dense / value, 2),
+    }))
+
+
+if __name__ == "__main__":
+    main()

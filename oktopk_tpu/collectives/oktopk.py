@@ -80,7 +80,7 @@ def _repartition(abs_acc, local_thresh, cfg: OkTopkConfig, axis_name: str):
         jnp.full((1,), n, jnp.int32)])
     # psum output is replication-invariant; the carried boundaries are
     # per-shard ("varying") under shard_map's VMA tracking — align them.
-    return lax.pvary(out, (axis_name,))
+    return pvary_tree(out, axis_name)
 
 
 def oktopk(grad: jnp.ndarray, state: SparseState, cfg: OkTopkConfig,

@@ -85,7 +85,7 @@ def pvary_tree(tree, axis_name: str):
             already = axis_name in jax.typeof(x).vma
         except Exception:
             already = False
-        return x if already else lax.pvary(x, (axis_name,))
+        return x if already else lax.pcast(x, axis_name, to="varying")
 
     return jax.tree.map(_pvary, tree)
 

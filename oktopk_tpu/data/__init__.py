@@ -1,0 +1,14 @@
+"""Dataset pipelines (reference C19, SURVEY.md §2.2: cifar10/imagenet/mnist/
+ptb/an4 prep in VGG/dl_trainer.py:262-446 and the BERT Wikipedia pipeline in
+BERT/bert/main_bert.py:257-366).
+
+Every loader yields numpy batches shaped [global_batch, ...]; the distributed
+step shards them over the data axis (the analogue of the reference's
+``DistributedSampler`` partitioning, VGG/dl_trainer.py:286-288). When the
+real dataset files are absent (this container has zero egress) loaders fall
+back to deterministic synthetic data with identical shapes/dtypes so every
+pipeline stays exercisable end-to-end.
+"""
+
+from oktopk_tpu.data.synthetic import synthetic_iterator  # noqa: F401
+from oktopk_tpu.data.loaders import make_dataset  # noqa: F401

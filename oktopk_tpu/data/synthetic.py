@@ -1,0 +1,58 @@
+"""Deterministic synthetic batches for every workload (shapes/dtypes match
+the real pipelines; used for smoke tests, benchmarks and as the zero-egress
+fallback)."""
+
+from __future__ import annotations
+
+from typing import Dict, Iterator
+
+import numpy as np
+
+
+def synthetic_batch(dnn: str, batch_size: int, rng: np.random.RandomState,
+                    seq_len: int = None) -> Dict[str, np.ndarray]:
+    if dnn == "lstm":
+        t = seq_len or 35
+        vocab = 10000
+        toks = rng.randint(0, vocab, size=(batch_size, t + 1))
+        return {"tokens": toks[:, :-1].astype(np.int32),
+                "targets": toks[:, 1:].astype(np.int32)}
+    if dnn.startswith("bert"):
+        t = seq_len or (32 if dnn == "bert_tiny" else 128)
+        vocab = 1024 if dnn == "bert_tiny" else 30522
+        ids = rng.randint(0, vocab, size=(batch_size, t)).astype(np.int32)
+        mlm = np.full((batch_size, t), -1, np.int32)
+        mask_pos = rng.rand(batch_size, t) < 0.15
+        mlm[mask_pos] = ids[mask_pos]
+        return {"input_ids": ids,
+                "token_type_ids": np.zeros((batch_size, t), np.int32),
+                "attention_mask": np.ones((batch_size, t), np.int32),
+                "mlm_labels": mlm,
+                "nsp_labels": rng.randint(0, 2, size=(batch_size,))
+                .astype(np.int32)}
+    if dnn == "lstman4":
+        f, t = 161, seq_len or 201
+        return {"spect": rng.randn(batch_size, f, t, 1).astype(np.float32),
+                "spect_lengths": np.full((batch_size,), t // 2, np.int32),
+                "labels": rng.randint(1, 29, size=(batch_size, 40))
+                .astype(np.int32),
+                "label_lengths": rng.randint(5, 20, size=(batch_size,))
+                .astype(np.int32)}
+    if dnn == "mnistnet":
+        return {"image": rng.randn(batch_size, 28, 28, 1).astype(np.float32),
+                "label": rng.randint(0, 10, size=(batch_size,))
+                .astype(np.int32)}
+    if dnn == "resnet50":
+        return {"image": rng.randn(batch_size, 224, 224, 3)
+                .astype(np.float32),
+                "label": rng.randint(0, 1000, size=(batch_size,))
+                .astype(np.int32)}
+    return {"image": rng.randn(batch_size, 32, 32, 3).astype(np.float32),
+            "label": rng.randint(0, 10, size=(batch_size,)).astype(np.int32)}
+
+
+def synthetic_iterator(dnn: str, batch_size: int, seed: int = 0,
+                       seq_len: int = None) -> Iterator[Dict[str, np.ndarray]]:
+    rng = np.random.RandomState(seed)
+    while True:
+        yield synthetic_batch(dnn, batch_size, rng, seq_len)

@@ -1,0 +1,24 @@
+"""Flax model zoo (reference C16/C18, SURVEY.md §2.2).
+
+Reference workload models (`_support_dnns`, VGG/dl_trainer.py:39):
+resnet50, resnet20/56/110, vgg19/vgg16, alexnet, lstman4 (DeepSpeech), lstm
+(PTB), plus mnistnet and BERT (BERT/bert/transformers/modeling.py).
+
+TPU-first conventions used throughout:
+- NHWC layout (XLA TPU's native conv layout);
+- a ``dtype`` knob for bfloat16 compute with float32 params;
+- BatchNorm takes an optional ``axis_name`` for cross-replica statistics
+  (the reference relies on per-GPU batch stats; on a TPU mesh syncing them
+  over the data axis is one flag);
+- no data-dependent Python control flow inside ``__call__``.
+"""
+
+from oktopk_tpu.models.registry import create_model, MODELS  # noqa: F401
+from oktopk_tpu.models.vgg import VGG  # noqa: F401
+from oktopk_tpu.models.resnet import CifarResNet  # noqa: F401
+from oktopk_tpu.models.imagenet_resnet import ResNet50  # noqa: F401
+from oktopk_tpu.models.alexnet import AlexNet  # noqa: F401
+from oktopk_tpu.models.mnistnet import MnistNet  # noqa: F401
+from oktopk_tpu.models.lstm import PTBLSTM  # noqa: F401
+from oktopk_tpu.models.deepspeech import DeepSpeech  # noqa: F401
+from oktopk_tpu.models.bert import BertConfig, BertForPreTraining  # noqa: F401

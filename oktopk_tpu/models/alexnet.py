@@ -1,0 +1,30 @@
+"""AlexNet (reference VGG/models/alexnet.py, CIFAR-sized variant)."""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class AlexNet(nn.Module):
+    num_classes: int = 10
+    dtype: Any = jnp.float32
+    axis_name: Optional[str] = None
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        conv = lambda f, k, s=1, p=0: nn.Conv(
+            f, (k, k), strides=s, padding=p, dtype=self.dtype)
+        x = conv(64, 3, 2, 1)(x); x = nn.relu(x)
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = conv(192, 3, 1, 1)(x); x = nn.relu(x)
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = conv(384, 3, 1, 1)(x); x = nn.relu(x)
+        x = conv(256, 3, 1, 1)(x); x = nn.relu(x)
+        x = conv(256, 3, 1, 1)(x); x = nn.relu(x)
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = x.reshape((x.shape[0], -1))
+        x = nn.Dense(self.num_classes, dtype=self.dtype)(x)
+        return x.astype(jnp.float32)

@@ -1,0 +1,163 @@
+"""BERT for pretraining (MLM + NSP), from-scratch Flax.
+
+Reference: the vendored HF modeling (BERT/bert/transformers/modeling.py:
+``BertEmbeddings``, ``BertSelfAttention``, ``BertLayer``, ``BertPooler``,
+``BertForPreTraining`` with the MLM transform head and NSP classifier; word
+embeddings are weight-tied into the MLM decoder — the staged model re-ties
+them explicitly at BERT/bert/models/bert/depth=4/__init__.py:17).
+
+TPU-first notes: attention mask enters as an additive bias built once
+(the reference materialises the same -10000.0 bias in its InputSource,
+BERT/bert/main_bert.py:621-638); all matmuls are dtype-parametric for
+bfloat16; shapes are static (fixed seq len, the reference uses 128).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class BertConfig:
+    vocab_size: int = 30522
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    intermediate_size: int = 3072
+    max_position: int = 512
+    type_vocab_size: int = 2
+    dropout: float = 0.1
+    layer_norm_eps: float = 1e-12
+    dtype: Any = jnp.float32
+
+    @staticmethod
+    def base(**kw) -> "BertConfig":
+        return BertConfig(**kw)
+
+    @staticmethod
+    def large(**kw) -> "BertConfig":
+        return BertConfig(hidden_size=1024, num_layers=24, num_heads=16,
+                          intermediate_size=4096, **kw)
+
+    @staticmethod
+    def tiny(**kw) -> "BertConfig":
+        """For tests and dry runs (not in the reference)."""
+        return BertConfig(vocab_size=1024, hidden_size=64, num_layers=2,
+                          num_heads=2, intermediate_size=128,
+                          max_position=128, **kw)
+
+
+class BertEmbeddings(nn.Module):
+    cfg: BertConfig
+
+    @nn.compact
+    def __call__(self, input_ids, token_type_ids, train: bool = True):
+        c = self.cfg
+        word = nn.Embed(c.vocab_size, c.hidden_size, dtype=c.dtype,
+                        name="word_embeddings")
+        pos = nn.Embed(c.max_position, c.hidden_size, dtype=c.dtype,
+                       name="position_embeddings")
+        typ = nn.Embed(c.type_vocab_size, c.hidden_size, dtype=c.dtype,
+                       name="token_type_embeddings")
+        positions = jnp.arange(input_ids.shape[1])[None, :]
+        x = word(input_ids) + pos(positions) + typ(token_type_ids)
+        x = nn.LayerNorm(epsilon=c.layer_norm_eps, dtype=c.dtype)(x)
+        return nn.Dropout(c.dropout, deterministic=not train)(x)
+
+
+class BertLayer(nn.Module):
+    cfg: BertConfig
+
+    @nn.compact
+    def __call__(self, x, attn_mask, train: bool = True):
+        c = self.cfg
+        drop = nn.Dropout(c.dropout, deterministic=not train)
+        ln = lambda nm: nn.LayerNorm(epsilon=c.layer_norm_eps, dtype=c.dtype,
+                                     name=nm)
+        attn = nn.MultiHeadDotProductAttention(
+            num_heads=c.num_heads, qkv_features=c.hidden_size,
+            out_features=c.hidden_size, dropout_rate=c.dropout,
+            deterministic=not train, dtype=c.dtype, name="attention")
+        y = attn(x, x, x, mask=attn_mask)
+        x = ln("attention_ln")(x + drop(y))
+        h = nn.Dense(c.intermediate_size, dtype=c.dtype, name="intermediate")(x)
+        h = nn.gelu(h, approximate=False)
+        h = nn.Dense(c.hidden_size, dtype=c.dtype, name="output")(h)
+        return ln("output_ln")(x + drop(h))
+
+
+class BertEncoder(nn.Module):
+    cfg: BertConfig
+
+    @nn.compact
+    def __call__(self, x, attn_mask, train: bool = True):
+        for i in range(self.cfg.num_layers):
+            x = BertLayer(self.cfg, name=f"layer_{i}")(x, attn_mask, train)
+        return x
+
+
+class BertModel(nn.Module):
+    cfg: BertConfig
+
+    def setup(self):
+        self.embeddings = BertEmbeddings(self.cfg)
+        self.encoder = BertEncoder(self.cfg)
+        self.pooler = nn.Dense(self.cfg.hidden_size, dtype=self.cfg.dtype)
+
+    def __call__(self, input_ids, token_type_ids=None, attention_mask=None,
+                 train: bool = True):
+        c = self.cfg
+        if token_type_ids is None:
+            token_type_ids = jnp.zeros_like(input_ids)
+        if attention_mask is None:
+            attention_mask = jnp.ones_like(input_ids)
+        # boolean attend-mask [B, 1, Tq, Tk] — the semantic equivalent of the
+        # reference's additive -10000.0 extended_attention_mask
+        # (BERT/bert/main_bert.py:633); flax applies the big-negative fill
+        # internally.
+        mask = attention_mask[:, None, None, :].astype(bool)
+        mask = jnp.broadcast_to(
+            mask, (input_ids.shape[0], 1, input_ids.shape[1],
+                   input_ids.shape[1]))
+        x = self.embeddings(input_ids, token_type_ids, train)
+        x = self.encoder(x, mask, train)
+        pooled = jnp.tanh(self.pooler(x[:, 0]))
+        return x, pooled
+
+    def word_embedding_table(self):
+        return self.embeddings.variables["params"]["word_embeddings"]["embedding"]
+
+
+class BertForPreTraining(nn.Module):
+    """MLM + NSP heads over BertModel; MLM decoder tied to the word
+    embedding table (reference modeling.py BertPreTrainingHeads)."""
+    cfg: BertConfig
+
+    def setup(self):
+        c = self.cfg
+        self.bert = BertModel(c)
+        self.mlm_dense = nn.Dense(c.hidden_size, dtype=c.dtype)
+        self.mlm_ln = nn.LayerNorm(epsilon=c.layer_norm_eps, dtype=c.dtype)
+        self.mlm_bias = self.param("mlm_bias", nn.initializers.zeros,
+                                   (c.vocab_size,))
+        self.nsp = nn.Dense(2, dtype=c.dtype)
+
+    def __call__(self, input_ids, token_type_ids=None, attention_mask=None,
+                 train: bool = True):
+        c = self.cfg
+        seq, pooled = self.bert(input_ids, token_type_ids, attention_mask,
+                                train)
+        h = self.mlm_dense(seq)
+        h = nn.gelu(h, approximate=False)
+        h = self.mlm_ln(h)
+        # weight tying: decode against the embedding table
+        table = self.bert.embeddings.variables["params"][
+            "word_embeddings"]["embedding"]
+        mlm_logits = jnp.einsum("bth,vh->btv", h, table.astype(c.dtype))
+        mlm_logits = mlm_logits + self.mlm_bias
+        nsp_logits = self.nsp(pooled)
+        return mlm_logits.astype(jnp.float32), nsp_logits.astype(jnp.float32)

@@ -1,0 +1,78 @@
+"""DeepSpeech-style CTC speech model (reference VGG/models/lstm_models.py:148
+— 2-conv spectrogram frontend (41x11 s(2,2), 21x11 s(2,1)) + hardtanh, a
+stack of bidirectional BatchRNN LSTM layers whose two directions are summed
+(:97-106), SequenceWise BatchNorm between layers (:21-43), and a bias-free
+classifier head (:199); the AN4 harness builds it with 5 layers × 800 hidden
+via VGG/models/lstman4.py:7).
+
+Input here is NHWC-ish [B, freq, time, 1] spectrograms; the head returns
+per-frame logits [B, T', num_classes] for ``optax.ctc_loss`` (the TPU
+replacement for warpctc, SURVEY.md §2.4).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+def hardtanh(x, lo=0.0, hi=20.0):
+    return jnp.clip(x, lo, hi)
+
+
+class BatchRNN(nn.Module):
+    """Bidirectional LSTM with summed directions + preceding BatchNorm
+    (reference lstm_models.py:83-106)."""
+    hidden: int
+    batch_norm: bool = True
+    dtype: Any = jnp.float32
+    axis_name: Optional[str] = None
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        if self.batch_norm:
+            x = nn.BatchNorm(use_running_average=not train, momentum=0.9,
+                             dtype=self.dtype, axis_name=self.axis_name)(x)
+        fwd = nn.RNN(nn.OptimizedLSTMCell(self.hidden, dtype=self.dtype))
+        bwd = nn.RNN(nn.OptimizedLSTMCell(self.hidden, dtype=self.dtype),
+                     reverse=True, keep_order=True)
+        return nn.Bidirectional(fwd, bwd, merge_fn=lambda a, b: a + b)(x)
+
+
+class DeepSpeech(nn.Module):
+    num_classes: int = 29          # AN4 label set incl. blank
+    rnn_hidden: int = 800          # reference lstman4 config (SURVEY.md §2.2)
+    num_layers: int = 5
+    dtype: Any = jnp.float32
+    axis_name: Optional[str] = None
+
+    @nn.compact
+    def __call__(self, spect, train: bool = True):
+        """spect [B, freq, time, 1] -> logits [B, T', num_classes]."""
+        bn = lambda: nn.BatchNorm(use_running_average=not train, momentum=0.9,
+                                  dtype=self.dtype, axis_name=self.axis_name)
+        x = nn.Conv(32, (41, 11), strides=(2, 2), padding=((20, 20), (5, 5)),
+                    dtype=self.dtype)(spect)
+        x = bn()(x)
+        x = hardtanh(x)
+        x = nn.Conv(32, (21, 11), strides=(2, 1), padding=((10, 10), (5, 5)),
+                    dtype=self.dtype)(x)
+        x = bn()(x)
+        x = hardtanh(x)
+        # [B, F', T', 32] -> [B, T', F'*32] (reference collapses channelxfreq
+        # before the RNN stack, lstm_models.py:178-184)
+        b, f, t, c = x.shape
+        x = jnp.transpose(x, (0, 2, 1, 3)).reshape((b, t, f * c))
+        first = BatchRNN(self.rnn_hidden, batch_norm=False, dtype=self.dtype,
+                         axis_name=self.axis_name)
+        x = first(x, train)
+        for _ in range(self.num_layers - 1):
+            x = BatchRNN(self.rnn_hidden, dtype=self.dtype,
+                         axis_name=self.axis_name)(x, train)
+        x = nn.BatchNorm(use_running_average=not train, momentum=0.9,
+                         dtype=self.dtype, axis_name=self.axis_name)(x)
+        logits = nn.Dense(self.num_classes, use_bias=False,
+                          dtype=self.dtype)(x)
+        return logits.astype(jnp.float32)

@@ -1,0 +1,60 @@
+"""ImageNet ResNet-50 (reference VGG/models/imagenet_resnet.py: standard
+bottleneck resnet50 used for the imagenet runs)."""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class Bottleneck(nn.Module):
+    filters: int
+    strides: int = 1
+    dtype: Any = jnp.float32
+    axis_name: Optional[str] = None
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        bn = lambda: nn.BatchNorm(use_running_average=not train, momentum=0.9,
+                                  dtype=self.dtype, axis_name=self.axis_name)
+        residual = x
+        y = nn.Conv(self.filters, (1, 1), use_bias=False, dtype=self.dtype)(x)
+        y = bn()(y); y = nn.relu(y)
+        y = nn.Conv(self.filters, (3, 3), strides=self.strides, padding=1,
+                    use_bias=False, dtype=self.dtype)(y)
+        y = bn()(y); y = nn.relu(y)
+        y = nn.Conv(4 * self.filters, (1, 1), use_bias=False,
+                    dtype=self.dtype)(y)
+        y = bn()(y)
+        if residual.shape != y.shape:
+            residual = nn.Conv(4 * self.filters, (1, 1), strides=self.strides,
+                               use_bias=False, dtype=self.dtype)(x)
+            residual = bn()(residual)
+        return nn.relu(y + residual)
+
+
+class ResNet50(nn.Module):
+    num_classes: int = 1000
+    stage_sizes: Sequence[int] = (3, 4, 6, 3)
+    dtype: Any = jnp.float32
+    axis_name: Optional[str] = None
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        x = nn.Conv(64, (7, 7), strides=2, padding=3, use_bias=False,
+                    dtype=self.dtype)(x)
+        x = nn.BatchNorm(use_running_average=not train, momentum=0.9,
+                         dtype=self.dtype, axis_name=self.axis_name)(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=((1, 1), (1, 1)))
+        for stage, nblocks in enumerate(self.stage_sizes):
+            filters = 64 * (2 ** stage)
+            for block in range(nblocks):
+                strides = 2 if stage > 0 and block == 0 else 1
+                x = Bottleneck(filters, strides, self.dtype,
+                               self.axis_name)(x, train)
+        x = jnp.mean(x, axis=(1, 2))
+        x = nn.Dense(self.num_classes, dtype=self.dtype)(x)
+        return x.astype(jnp.float32)

@@ -1,0 +1,48 @@
+"""PTB language-model LSTM (reference VGG/models/lstm.py:5 — 2×1500 LSTM,
+1500-d embedding, dropout keep 0.35, 35-step truncated BPTT).
+
+The reference threads torch hidden state across iterations and
+``repackage_hidden``s it to cut the graph (VGG/models/lstm.py:42); here the
+carry is an explicit pytree the trainer passes back in — no graph surgery
+needed under jit.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+class PTBLSTM(nn.Module):
+    vocab_size: int = 10000
+    hidden_size: int = 1500
+    num_layers: int = 2
+    dropout_keep: float = 0.35
+    dtype: Any = jnp.float32
+
+    def initial_carry(self, batch_size: int):
+        shape = (batch_size, self.hidden_size)
+        zeros = jnp.zeros(shape, self.dtype)
+        return tuple((zeros, zeros) for _ in range(self.num_layers))
+
+    @nn.compact
+    def __call__(self, tokens, carry=None, train: bool = True):
+        """tokens [B, T] int32 -> (logits [B, T, V], new_carry)."""
+        drop = nn.Dropout(1.0 - self.dropout_keep, deterministic=not train)
+        x = nn.Embed(self.vocab_size, self.hidden_size,
+                     dtype=self.dtype)(tokens)
+        x = drop(x)
+        if carry is None:
+            carry = self.initial_carry(tokens.shape[0])
+        new_carry = []
+        for layer in range(self.num_layers):
+            cell = nn.OptimizedLSTMCell(self.hidden_size, dtype=self.dtype)
+            c, x = nn.RNN(cell, return_carry=True)(
+                x, initial_carry=carry[layer])
+            new_carry.append(c)
+            x = drop(x)
+        logits = nn.Dense(self.vocab_size, dtype=self.dtype)(x)
+        return logits.astype(jnp.float32), tuple(new_carry)

@@ -1,0 +1,56 @@
+"""Model registry (reference ``_support_dnns``, VGG/dl_trainer.py:39, plus
+BERT). ``create_model(dnn)`` returns ``(module, example_input_fn)`` where the
+example input matches the workload's dataset shapes."""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Tuple
+
+import jax.numpy as jnp
+
+from oktopk_tpu.models.alexnet import AlexNet
+from oktopk_tpu.models.bert import BertConfig, BertForPreTraining
+from oktopk_tpu.models.deepspeech import DeepSpeech
+from oktopk_tpu.models.imagenet_resnet import ResNet50
+from oktopk_tpu.models.lstm import PTBLSTM
+from oktopk_tpu.models.mnistnet import MnistNet
+from oktopk_tpu.models.resnet import CifarResNet
+from oktopk_tpu.models.vgg import VGG
+
+
+def _img(h, w, c):
+    return lambda bs: jnp.zeros((bs, h, w, c), jnp.float32)
+
+
+def _tokens(t, vocab):
+    return lambda bs: jnp.zeros((bs, t), jnp.int32)
+
+
+MODELS: Dict[str, Callable[..., Tuple[Any, Callable]]] = {
+    "vgg16": lambda **kw: (VGG(name_cfg="vgg16", **kw), _img(32, 32, 3)),
+    "vgg19": lambda **kw: (VGG(name_cfg="vgg19", **kw), _img(32, 32, 3)),
+    "resnet20": lambda **kw: (CifarResNet(depth=20, **kw), _img(32, 32, 3)),
+    "resnet56": lambda **kw: (CifarResNet(depth=56, **kw), _img(32, 32, 3)),
+    "resnet110": lambda **kw: (CifarResNet(depth=110, **kw), _img(32, 32, 3)),
+    "resnet50": lambda **kw: (ResNet50(**kw), _img(224, 224, 3)),
+    "alexnet": lambda **kw: (AlexNet(**kw), _img(32, 32, 3)),
+    "mnistnet": lambda **kw: (MnistNet(**kw), _img(28, 28, 1)),
+    "lstm": lambda **kw: (PTBLSTM(**kw), _tokens(35, 10000)),
+    "lstman4": lambda **kw: (DeepSpeech(**kw),
+                             lambda bs: jnp.zeros((bs, 161, 201, 1),
+                                                  jnp.float32)),
+    "bert_base": lambda **kw: (
+        BertForPreTraining(BertConfig.base(**kw)), _tokens(128, 30522)),
+    "bert_large": lambda **kw: (
+        BertForPreTraining(BertConfig.large(**kw)), _tokens(128, 30522)),
+    "bert_tiny": lambda **kw: (
+        BertForPreTraining(BertConfig.tiny(**kw)), _tokens(32, 1024)),
+}
+
+
+def create_model(dnn: str, **kw):
+    try:
+        factory = MODELS[dnn]
+    except KeyError:
+        raise ValueError(f"unknown dnn {dnn!r}; supported: {sorted(MODELS)}")
+    return factory(**kw)

@@ -1,0 +1,58 @@
+"""CIFAR ResNets — resnet20/56/110 (reference VGG/models/resnet.py: basic
+blocks, widths 16/32/64, n = (depth-2)/6 blocks per stage)."""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class BasicBlock(nn.Module):
+    filters: int
+    strides: int = 1
+    dtype: Any = jnp.float32
+    axis_name: Optional[str] = None
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        bn = lambda: nn.BatchNorm(use_running_average=not train, momentum=0.9,
+                                  dtype=self.dtype, axis_name=self.axis_name)
+        residual = x
+        y = nn.Conv(self.filters, (3, 3), strides=self.strides, padding=1,
+                    use_bias=False, dtype=self.dtype)(x)
+        y = bn()(y)
+        y = nn.relu(y)
+        y = nn.Conv(self.filters, (3, 3), padding=1, use_bias=False,
+                    dtype=self.dtype)(y)
+        y = bn()(y)
+        if residual.shape != y.shape:
+            residual = nn.Conv(self.filters, (1, 1), strides=self.strides,
+                               use_bias=False, dtype=self.dtype)(x)
+            residual = bn()(residual)
+        return nn.relu(y + residual)
+
+
+class CifarResNet(nn.Module):
+    depth: int = 20
+    num_classes: int = 10
+    dtype: Any = jnp.float32
+    axis_name: Optional[str] = None
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        assert (self.depth - 2) % 6 == 0, "depth must be 6n+2"
+        n = (self.depth - 2) // 6
+        x = nn.Conv(16, (3, 3), padding=1, use_bias=False, dtype=self.dtype)(x)
+        x = nn.BatchNorm(use_running_average=not train, momentum=0.9,
+                         dtype=self.dtype, axis_name=self.axis_name)(x)
+        x = nn.relu(x)
+        for stage, filters in enumerate([16, 32, 64]):
+            for block in range(n):
+                strides = 2 if stage > 0 and block == 0 else 1
+                x = BasicBlock(filters, strides, self.dtype,
+                               self.axis_name)(x, train)
+        x = jnp.mean(x, axis=(1, 2))
+        x = nn.Dense(self.num_classes, dtype=self.dtype)(x)
+        return x.astype(jnp.float32)

@@ -1,0 +1,46 @@
+"""VGG with BatchNorm (reference VGG/models/vgg.py:14 — 'VGG16' = conv cfg D
+with BN + single 512->num_classes classifier head, CIFAR-sized)."""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Sequence, Union
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+CFG = {
+    "vgg11": [64, "M", 128, "M", 256, 256, "M", 512, 512, "M", 512, 512, "M"],
+    "vgg13": [64, 64, "M", 128, 128, "M", 256, 256, "M", 512, 512, "M",
+              512, 512, "M"],
+    "vgg16": [64, 64, "M", 128, 128, "M", 256, 256, 256, "M",
+              512, 512, 512, "M", 512, 512, 512, "M"],
+    "vgg19": [64, 64, "M", 128, 128, "M", 256, 256, 256, 256, "M",
+              512, 512, 512, 512, "M", 512, 512, 512, 512, "M"],
+}
+
+
+class VGG(nn.Module):
+    """CIFAR VGG: conv stacks from CFG, then averaged 1x1 -> Dense head
+    (the reference flattens 512*1*1 -> Linear(512, 10),
+    VGG/models/vgg.py:20-24)."""
+
+    name_cfg: str = "vgg16"
+    num_classes: int = 10
+    dtype: Any = jnp.float32
+    axis_name: Optional[str] = None
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        for v in CFG[self.name_cfg]:
+            if v == "M":
+                x = nn.max_pool(x, (2, 2), strides=(2, 2))
+            else:
+                x = nn.Conv(v, (3, 3), padding=1, use_bias=True,
+                            dtype=self.dtype)(x)
+                x = nn.BatchNorm(use_running_average=not train,
+                                 momentum=0.9, dtype=self.dtype,
+                                 axis_name=self.axis_name)(x)
+                x = nn.relu(x)
+        x = x.reshape((x.shape[0], -1))
+        x = nn.Dense(self.num_classes, dtype=self.dtype)(x)
+        return x.astype(jnp.float32)

@@ -1,0 +1,10 @@
+"""Training loop layer (reference L4/L5: DLTrainer at VGG/dl_trainer.py:105,
+drivers at VGG/main_trainer.py:26 and BERT/bert/main_bert.py:641)."""
+
+from oktopk_tpu.train.losses import (  # noqa: F401
+    softmax_cross_entropy,
+    lm_cross_entropy,
+    ctc_loss,
+    bert_pretrain_loss,
+)
+from oktopk_tpu.train.trainer import Trainer  # noqa: F401

@@ -1,0 +1,83 @@
+"""Checkpoint / resume — including the sparse-algorithm state.
+
+Reference behaviour: VGG/LSTM assemble per-epoch checkpoints (commented-out
+save at VGG/dl_trainer.py:623-634,792-793) and resume via --pretrain
+(:202-257); BERT saves per-epoch stage checkpoints
+(BERT/bert/main_bert.py:207-219,1089-1096). Crucially the reference NEVER
+checkpoints compressor residuals, thresholds or region boundaries (class-attr
+dicts, VGG/compression.py:28,170) — a resume silently resets error feedback
+(SURVEY.md §5.4). Here the whole DistTrainState — params, optimizer moments,
+batch stats, residual, thresholds, boundaries, step counters — is one pytree,
+serialised with flax msgpack.
+
+Also provides the SLURM-preemption shape the reference declares
+(save-on-signal -> requeue, BERT/bert/main_bert.py:73-153):
+``install_preempt_handler`` saves an interrupted state on SIGTERM/SIGUSR1.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+from typing import Any, Callable, Optional, Tuple
+
+import flax.serialization
+import jax
+import numpy as np
+
+
+def save_checkpoint(ckpt_dir: str, state: Any, step: int,
+                    prefix: str = "ckpt") -> str:
+    """Serialise the full train state to ``<ckpt_dir>/<prefix>-<step>.msgpack``."""
+    os.makedirs(ckpt_dir, exist_ok=True)
+    host_state = jax.device_get(state)
+    path = os.path.join(ckpt_dir, f"{prefix}-{step}.msgpack")
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        f.write(flax.serialization.to_bytes({"step": step,
+                                             "state": host_state}))
+    os.replace(tmp, path)   # atomic publish
+    return path
+
+
+def latest_checkpoint(ckpt_dir: str, prefix: str = "ckpt") -> Optional[str]:
+    if not os.path.isdir(ckpt_dir):
+        return None
+    steps = []
+    for f in os.listdir(ckpt_dir):
+        if f.startswith(prefix + "-") and f.endswith(".msgpack"):
+            try:
+                steps.append((int(f[len(prefix) + 1:-len(".msgpack")]), f))
+            except ValueError:
+                continue
+    if not steps:
+        return None
+    return os.path.join(ckpt_dir, max(steps)[1])
+
+
+def restore_checkpoint(ckpt_dir_or_file: str, state_template: Any,
+                       prefix: str = "ckpt") -> Tuple[Any, int]:
+    """Restore into the template's pytree structure; returns (state, step)."""
+    path = ckpt_dir_or_file
+    if os.path.isdir(path):
+        path = latest_checkpoint(path, prefix)
+        if path is None:
+            raise FileNotFoundError(f"no checkpoints in {ckpt_dir_or_file}")
+    with open(path, "rb") as f:
+        payload = flax.serialization.from_bytes(
+            {"step": 0, "state": jax.device_get(state_template)}, f.read())
+    return payload["state"], int(payload["step"])
+
+
+def install_preempt_handler(save_fn: Callable[[], None],
+                            signals=(signal.SIGTERM, signal.SIGUSR1)):
+    """On preemption signals, save state then re-raise the default behaviour
+    (reference save_interrupted_state/requeue shape,
+    BERT/bert/main_bert.py:99-153; requeue itself belongs to the scheduler)."""
+    def handler(signum, frame):
+        save_fn()
+        signal.signal(signum, signal.SIG_DFL)
+        os.kill(os.getpid(), signum)
+
+    for s in signals:
+        signal.signal(s, handler)

@@ -1,0 +1,54 @@
+"""Workload loss functions.
+
+Reference criteria: CrossEntropyLoss for CNNs and PTB (VGG/dl_trainer.py:
+181-186,661-677), warp-ctc CTCLoss for AN4 (:181-182 — replaced by
+``optax.ctc_loss``, SURVEY.md §2.4), and BERT's masked-LM + NSP cross
+entropies with ignore_index=-1 (BERT/runtime.py criterion path :573-640).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import optax
+
+
+def softmax_cross_entropy(logits, labels):
+    """Mean CE over integer labels [B] (CNN classification)."""
+    return optax.softmax_cross_entropy_with_integer_labels(
+        logits, labels).mean()
+
+
+def lm_cross_entropy(logits, targets):
+    """Mean CE over [B, T] targets (PTB language modelling; perplexity =
+    exp(loss))."""
+    return optax.softmax_cross_entropy_with_integer_labels(
+        logits, targets).mean()
+
+
+def ctc_loss(logits, logit_lengths, labels, label_lengths, blank_id: int = 0):
+    """CTC on per-frame logits [B, T, C] (replaces warpctc_pytorch).
+
+    ``optax.ctc_loss`` wants paddings, not lengths — convert.
+    """
+    bt = logits.shape[:2]
+    t_ids = jnp.arange(bt[1])[None, :]
+    logit_pad = (t_ids >= logit_lengths[:, None]).astype(jnp.float32)
+    l_ids = jnp.arange(labels.shape[1])[None, :]
+    label_pad = (l_ids >= label_lengths[:, None]).astype(jnp.float32)
+    per_seq = optax.ctc_loss(logits, logit_pad, labels, label_pad,
+                             blank_id=blank_id)
+    return per_seq.mean()
+
+
+def bert_pretrain_loss(mlm_logits, nsp_logits, mlm_labels, nsp_labels):
+    """Masked-LM CE (ignore_index=-1) + next-sentence CE, as in the
+    reference's pretraining criterion."""
+    vocab = mlm_logits.shape[-1]
+    mask = (mlm_labels >= 0).astype(jnp.float32)
+    safe_labels = jnp.maximum(mlm_labels, 0)
+    per_tok = optax.softmax_cross_entropy_with_integer_labels(
+        mlm_logits, safe_labels)
+    mlm = jnp.sum(per_tok * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+    nsp = optax.softmax_cross_entropy_with_integer_labels(
+        nsp_logits, nsp_labels).mean()
+    return mlm + nsp, {"mlm_loss": mlm, "nsp_loss": nsp}

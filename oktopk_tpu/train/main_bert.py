@@ -1,0 +1,101 @@
+"""CLI driver for BERT pretraining (reference BERT/bert/main_bert.py:641-1100
+with the bert_oktopk.sh flag surface: --dataparallel --compressor oktopk
+--density 0.01, bs 8/worker, seq 128, BertAdam lr 2e-4 warmup-linear).
+
+The reference's SLURM rendezvous (init_distrib_slurm, :159-203), stage-module
+importlib machinery (:806-822) and shape-inference dry run (:838-868) are all
+unnecessary here: one process drives the mesh, the model is a single Flax
+module, and shapes are static.
+
+Example:
+    python -m oktopk_tpu.train.main_bert --model bert_base \\
+        --compressor oktopk --density 0.01 --num-minibatches 1024
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--model", default="bert_base",
+                   choices=["bert_base", "bert_large", "bert_tiny"])
+    p.add_argument("--batch-size", type=int, default=8,
+                   help="per-worker microbatch (reference bs 8)")
+    p.add_argument("--max-seq-length", type=int, default=128)
+    p.add_argument("--lr", type=float, default=2e-4)
+    p.add_argument("--warmup-proportion", type=float, default=0.01)
+    p.add_argument("--num-minibatches", type=int, default=1024)
+    p.add_argument("--gradient-accumulation-steps", type=int, default=1)
+    p.add_argument("--compressor", default="oktopk")
+    p.add_argument("--density", type=float, default=0.01)
+    p.add_argument("--data-dir", default="./data")
+    p.add_argument("--seed", type=int, default=42)
+    p.add_argument("--fake-devices", type=int, default=0)
+    p.add_argument("--log-every", type=int, default=10)
+    p.add_argument("--ckpt-dir", default=None)
+    p.add_argument("--resume", default=None)
+    return p.parse_args(argv)
+
+
+def main(argv=None):
+    args = parse_args(argv)
+    if args.fake_devices:
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + f" --xla_force_host_platform_device_count={args.fake_devices}")
+    import jax
+    if args.fake_devices:
+        jax.config.update("jax_platforms", "cpu")
+
+    from oktopk_tpu.config import OkTopkConfig, TrainConfig
+    from oktopk_tpu.data import make_dataset
+    from oktopk_tpu.train.trainer import Trainer
+    from oktopk_tpu.utils.logging import get_logger
+
+    num_workers = len(jax.devices())
+    cfg = TrainConfig(
+        dnn=args.model, dataset="wikipedia", batch_size=args.batch_size,
+        lr=args.lr, compressor=args.compressor, density=args.density,
+        nsteps_update=args.gradient_accumulation_steps, seed=args.seed,
+        warmup_proportion=args.warmup_proportion,
+        total_steps=args.num_minibatches, num_workers=num_workers)
+    logger = get_logger("oktopk_tpu.bert")
+    logger.info("BERT pretrain: %s on %d devices, compressor=%s density=%g",
+                args.model, num_workers, args.compressor, args.density)
+
+    # BERT disables dense warmup (reference BERT/bert/allreducer.py:355) and
+    # retunes cadences/scales (:359-361, :188-190)
+    algo_cfg = OkTopkConfig(
+        warmup_steps=0, local_recompute_every=128,
+        global_recompute_every=128, repartition_every=64,
+        local_adapt_scale=1.025, global_adapt_scale=1.036)
+
+    trainer = Trainer(cfg, algo_cfg=algo_cfg)
+    if args.resume:
+        from oktopk_tpu.train.checkpoint import restore_checkpoint
+        trainer.state, start = restore_checkpoint(args.resume, trainer.state)
+        logger.info("resumed at step %d", start)
+
+    global_bs = (args.batch_size * num_workers
+                 * args.gradient_accumulation_steps)
+    data_iter, meta = make_dataset("wikipedia", args.model, global_bs,
+                                   path=args.data_dir, seed=args.seed)
+    if meta.get("synthetic"):
+        logger.warning("Wikipedia shards not found: synthetic MLM/NSP data")
+
+    m = trainer.train(data_iter, args.num_minibatches,
+                      log_every=args.log_every, logger=logger)
+    logger.info("done: loss %.4f comm volume/step %.0f elems",
+                float(m["loss"]), float(m["comm_volume"]))
+    if args.ckpt_dir:
+        from oktopk_tpu.train.checkpoint import save_checkpoint
+        save_checkpoint(args.ckpt_dir, trainer.state, args.num_minibatches)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,126 @@
+"""CLI driver for the CNN/LSTM workloads (reference VGG/main_trainer.py and
+LSTM/main_trainer.py: robust_ssgd + argparse at :143-180).
+
+The reference launches one MPI rank per GPU node via srun; here one process
+drives the whole mesh. ``--fake-devices N`` reproduces the multi-worker
+topology on CPU for dry runs (the reference's two-local-process trick,
+SURVEY.md §4).
+
+Example:
+    python -m oktopk_tpu.train.main_trainer --dnn vgg16 --dataset cifar10 \\
+        --batch-size 16 --lr 0.1 --compressor oktopk --density 0.02 \\
+        --max-iters 200
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser(description=__doc__)
+    # reference flag surface (VGG/main_trainer.py:144-159)
+    p.add_argument("--dnn", default="vgg16")
+    p.add_argument("--dataset", default="cifar10")
+    p.add_argument("--data-dir", default="./data")
+    p.add_argument("--batch-size", type=int, default=16)
+    p.add_argument("--lr", type=float, default=0.1)
+    p.add_argument("--momentum", type=float, default=0.9)
+    p.add_argument("--weight-decay", type=float, default=5e-4)
+    p.add_argument("--nesterov", action="store_true")
+    p.add_argument("--max-epochs", type=int, default=161)
+    p.add_argument("--max-iters", type=int, default=0,
+                   help="if set, run exactly this many iterations")
+    p.add_argument("--nsteps-update", type=int, default=1)
+    p.add_argument("--compressor", default="oktopk")
+    p.add_argument("--density", type=float, default=0.02)
+    p.add_argument("--sigma-scale", type=float, default=2.5)
+    p.add_argument("--grad-clip", type=float, default=None)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--warmup-steps", type=int, default=None,
+                   help="dense warmup iterations (default: reference's 512)")
+    p.add_argument("--fake-devices", type=int, default=0,
+                   help="virtual CPU devices for dry runs")
+    p.add_argument("--log-every", type=int, default=50)
+    p.add_argument("--logdir", default="./logs")
+    p.add_argument("--ckpt-dir", default=None)
+    p.add_argument("--ckpt-every", type=int, default=0,
+                   help="checkpoint every N iterations (0 = off)")
+    p.add_argument("--resume", default=None,
+                   help="checkpoint directory to resume from")
+    return p.parse_args(argv)
+
+
+def main(argv=None):
+    args = parse_args(argv)
+    if args.fake_devices:
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + f" --xla_force_host_platform_device_count={args.fake_devices}")
+        os.environ["JAX_PLATFORMS"] = "cpu"
+
+    import jax
+    if args.fake_devices:
+        jax.config.update("jax_platforms", "cpu")
+
+    from oktopk_tpu.config import OkTopkConfig, TrainConfig
+    from oktopk_tpu.data import make_dataset
+    from oktopk_tpu.train.trainer import Trainer
+    from oktopk_tpu.utils.logging import get_logger
+
+    cfg = TrainConfig(
+        dnn=args.dnn, dataset=args.dataset, batch_size=args.batch_size,
+        lr=args.lr, momentum=args.momentum, weight_decay=args.weight_decay,
+        nesterov=args.nesterov, max_epochs=args.max_epochs,
+        nsteps_update=args.nsteps_update, compressor=args.compressor,
+        density=args.density, sigma_scale=args.sigma_scale,
+        grad_clip=args.grad_clip, seed=args.seed,
+        num_workers=len(jax.devices()))
+    slug = cfg.experiment_slug()
+    logger = get_logger("oktopk_tpu",
+                        os.path.join(args.logdir, slug, "rank0.log"))
+    logger.info("experiment %s on %d devices", slug, len(jax.devices()))
+
+    algo_cfg = OkTopkConfig(sigma_scale=args.sigma_scale)
+    if args.warmup_steps is not None:
+        algo_cfg = algo_cfg.replace(warmup_steps=args.warmup_steps)
+
+    trainer = Trainer(cfg, algo_cfg=algo_cfg)
+
+    if args.resume:
+        from oktopk_tpu.train.checkpoint import restore_checkpoint
+        trainer.state, start_iter = restore_checkpoint(
+            args.resume, trainer.state)
+        logger.info("resumed from %s at iter %d", args.resume, start_iter)
+
+    # global batch = per-worker batch * workers * accumulation
+    global_bs = (args.batch_size * trainer.algo_cfg.num_workers
+                 * args.nsteps_update)
+    data_iter, meta = make_dataset(args.dataset, args.dnn, global_bs,
+                                   path=args.data_dir, seed=args.seed)
+    if meta.get("synthetic"):
+        logger.warning("dataset %s not found on disk: using synthetic data",
+                       args.dataset)
+
+    iters_per_epoch = max(1, meta["num_examples"] // global_bs)
+    total = args.max_iters or args.max_epochs * iters_per_epoch
+    logger.info("training %d iterations (%d/epoch)", total, iters_per_epoch)
+
+    done = 0
+    while done < total:
+        chunk = min(total - done, iters_per_epoch)
+        m = trainer.train(data_iter, chunk, log_every=args.log_every,
+                          logger=logger)
+        done += chunk
+        logger.info("epoch done @ iter %d: loss %.4f vol/step %.0f", done,
+                    float(m["loss"]), float(m["comm_volume"]))
+        if args.ckpt_dir and args.ckpt_every and done % args.ckpt_every == 0:
+            from oktopk_tpu.train.checkpoint import save_checkpoint
+            save_checkpoint(args.ckpt_dir, trainer.state, done)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

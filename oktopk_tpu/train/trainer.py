@@ -1,0 +1,216 @@
+"""Trainer: model + data + distributed optimizer wiring.
+
+Reference analogue: ``DLTrainer`` (VGG/dl_trainer.py:105-796) builds the net,
+data loaders and base optimizer; ``robust_ssgd`` (VGG/main_trainer.py:26)
+wraps it with the distributed optimizer and runs the epoch loop; BERT's
+``main`` (BERT/bert/main_bert.py:641) does the same with BertAdam. Here one
+Trainer covers all three drivers: the workload decides the loss function and
+optimizer family, and the distributed step comes from
+``optim.build_sparse_grad_step``.
+
+The initial-model broadcast (reference ``comm.bcast(net.state_dict())``,
+VGG/main_trainer.py:52-54) is unnecessary: params are initialised once on
+host and replicated by sharding spec.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, Iterable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh
+
+from oktopk_tpu.config import OkTopkConfig, TrainConfig
+from oktopk_tpu.models import create_model
+from oktopk_tpu.optim import bert_adam, sgd
+from oktopk_tpu.optim.distributed import (
+    DistTrainState,
+    build_sparse_grad_step,
+    flat_size,
+    init_dist_state,
+)
+from oktopk_tpu.train import losses
+from oktopk_tpu.comm.mesh import get_mesh
+
+CNN_DNNS = {"vgg16", "vgg19", "resnet20", "resnet56", "resnet110",
+            "resnet50", "alexnet", "mnistnet"}
+
+
+class Trainer:
+    """End-to-end distributed trainer over a data-parallel mesh."""
+
+    def __init__(self, cfg: TrainConfig, mesh: Optional[Mesh] = None,
+                 algo_cfg: Optional[OkTopkConfig] = None,
+                 model_kwargs: Optional[Dict[str, Any]] = None,
+                 axis_name: str = "data", warmup: bool = True):
+        self.cfg = cfg
+        self.mesh = mesh if mesh is not None else get_mesh()
+        self.axis_name = axis_name
+        num_workers = int(np.prod(
+            [self.mesh.shape[a] for a in (axis_name,)]))
+        cfg = cfg if cfg.num_workers == num_workers else \
+            cfg.__class__(**{**cfg.__dict__, "num_workers": num_workers})
+        self.cfg = cfg
+
+        mk = dict(model_kwargs or {})
+        self.model, example_fn = create_model(cfg.dnn, **mk)
+        self.example_fn = example_fn
+
+        rng = jax.random.PRNGKey(cfg.seed)
+        init_batch = self._example_batch(2)
+        variables = self._init_variables(rng, init_batch)
+        params = variables.pop("params")
+        self.model_state = dict(variables)
+
+        n = flat_size(params)
+        self.algo_cfg = (algo_cfg or OkTopkConfig()).replace(
+            n=n, num_workers=num_workers, density=cfg.density)
+
+        if cfg.dnn.startswith("bert"):
+            self.optimizer = bert_adam(
+                lr=cfg.lr, warmup=cfg.warmup_proportion,
+                t_total=cfg.total_steps or -1)
+        else:
+            self.optimizer = sgd(cfg.lr, momentum=cfg.momentum,
+                                 weight_decay=cfg.weight_decay,
+                                 nesterov=cfg.nesterov)
+
+        self.state = init_dist_state(params, self.model_state,
+                                     self.optimizer, self.algo_cfg)
+        self.step_fn = build_sparse_grad_step(
+            self._loss_fn, self.optimizer, self.algo_cfg, self.mesh,
+            compressor=cfg.compressor, axis_name=axis_name,
+            nsteps_update=cfg.nsteps_update, grad_clip=cfg.grad_clip,
+            warmup=warmup)
+        self._rng = jax.random.PRNGKey(cfg.seed + 1)
+        self.metrics_history = []
+
+    # ---- workload-specific pieces -------------------------------------
+
+    def _init_variables(self, rng, batch):
+        rngs = {"params": rng, "dropout": jax.random.fold_in(rng, 1)}
+        if self.cfg.dnn == "lstm":
+            return self.model.init(rngs, batch["tokens"], train=False)
+        if self.cfg.dnn.startswith("bert"):
+            return self.model.init(rngs, batch["input_ids"],
+                                   batch["token_type_ids"],
+                                   batch["attention_mask"], train=False)
+        if self.cfg.dnn == "lstman4":
+            return self.model.init(rngs, batch["spect"], train=False)
+        return self.model.init(rngs, batch["image"], train=False)
+
+    def _example_batch(self, bs: int):
+        """Zero-filled batch with the workload's shapes (for init/tracing)."""
+        dnn = self.cfg.dnn
+        if dnn == "lstm":
+            t = 35
+            return {"tokens": jnp.zeros((bs, t), jnp.int32),
+                    "targets": jnp.zeros((bs, t), jnp.int32)}
+        if dnn.startswith("bert"):
+            t = 32 if dnn == "bert_tiny" else 128
+            return {"input_ids": jnp.zeros((bs, t), jnp.int32),
+                    "token_type_ids": jnp.zeros((bs, t), jnp.int32),
+                    "attention_mask": jnp.ones((bs, t), jnp.int32),
+                    "mlm_labels": jnp.full((bs, t), -1, jnp.int32),
+                    "nsp_labels": jnp.zeros((bs,), jnp.int32)}
+        if dnn == "lstman4":
+            return {"spect": jnp.zeros((bs, 161, 201, 1), jnp.float32),
+                    "spect_lengths": jnp.full((bs,), 101, jnp.int32),
+                    "labels": jnp.zeros((bs, 40), jnp.int32),
+                    "label_lengths": jnp.full((bs,), 10, jnp.int32)}
+        img = self.example_fn(bs)
+        return {"image": img,
+                "label": jnp.zeros((bs,), jnp.int32)}
+
+    def _loss_fn(self, params, model_state, batch, rng):
+        dnn = self.cfg.dnn
+        variables = {"params": params, **model_state}
+        mutable = [k for k in model_state]
+        rngs = {"dropout": rng}
+
+        if dnn == "lstm":
+            (logits, _), mut = self.model.apply(
+                variables, batch["tokens"], train=True, mutable=mutable,
+                rngs=rngs)
+            loss = losses.lm_cross_entropy(logits, batch["targets"])
+            return loss, (dict(mut), {})
+        if dnn.startswith("bert"):
+            (mlm, nsp), mut = self.model.apply(
+                variables, batch["input_ids"], batch["token_type_ids"],
+                batch["attention_mask"], train=True, mutable=mutable,
+                rngs=rngs)
+            loss, aux = losses.bert_pretrain_loss(
+                mlm, nsp, batch["mlm_labels"], batch["nsp_labels"])
+            return loss, (dict(mut), aux)
+        if dnn == "lstman4":
+            logits, mut = self.model.apply(
+                variables, batch["spect"], train=True, mutable=mutable,
+                rngs=rngs)
+            frames = logits.shape[1]
+            frame_len = jnp.minimum(batch["spect_lengths"], frames)
+            loss = losses.ctc_loss(logits, frame_len, batch["labels"],
+                                   batch["label_lengths"])
+            return loss, (dict(mut), {})
+        logits, mut = self.model.apply(
+            variables, batch["image"], train=True, mutable=mutable, rngs=rngs)
+        loss = losses.softmax_cross_entropy(logits, batch["label"])
+        return loss, (dict(mut), {})
+
+    # ---- loops --------------------------------------------------------
+
+    def train_step(self, batch):
+        self._rng, rng = jax.random.split(self._rng)
+        self.state, metrics = self.step_fn(self.state, batch, rng)
+        return metrics
+
+    def train(self, data_iter: Iterable, num_iters: int,
+              log_every: int = 50, logger=None):
+        """Run ``num_iters`` steps (reference trainer.train(nsteps),
+        VGG/dl_trainer.py:597). Returns the last metrics dict."""
+        metrics = {}
+        t0 = time.time()
+        for i in range(num_iters):
+            batch = next(data_iter)
+            metrics = self.train_step(batch)
+            if logger and (i + 1) % log_every == 0:
+                dt = (time.time() - t0) / log_every
+                logger.info(
+                    "iter %d loss %.4f vol %.0f %.3fs/it", i + 1,
+                    float(metrics["loss"]), float(metrics["comm_volume"]),
+                    dt)
+                t0 = time.time()
+        self.metrics_history.append(
+            {k: float(np.asarray(v).mean()) for k, v in metrics.items()})
+        return metrics
+
+    # ---- eval ---------------------------------------------------------
+
+    def eval_step(self, batch):
+        """Forward-only accuracy/loss on a replicated batch (reference
+        DLTrainer.test, VGG/dl_trainer.py:709)."""
+        params = self.state.params
+        variables = {"params": params, **self.state.model_state}
+        dnn = self.cfg.dnn
+        if dnn == "lstm":
+            logits, _ = self.model.apply(variables, batch["tokens"],
+                                         train=False)
+            loss = losses.lm_cross_entropy(logits, batch["targets"])
+            return {"loss": loss, "ppl": jnp.exp(loss)}
+        if dnn.startswith("bert"):
+            mlm, nsp = self.model.apply(
+                variables, batch["input_ids"], batch["token_type_ids"],
+                batch["attention_mask"], train=False)
+            loss, aux = losses.bert_pretrain_loss(
+                mlm, nsp, batch["mlm_labels"], batch["nsp_labels"])
+            return {"loss": loss, **aux}
+        if dnn == "lstman4":
+            logits = self.model.apply(variables, batch["spect"], train=False)
+            return {"loss": jnp.asarray(0.0)}
+        logits = self.model.apply(variables, batch["image"], train=False)
+        loss = losses.softmax_cross_entropy(logits, batch["label"])
+        acc = jnp.mean(
+            (jnp.argmax(logits, -1) == batch["label"]).astype(jnp.float32))
+        return {"loss": loss, "accuracy": acc}

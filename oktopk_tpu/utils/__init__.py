@@ -1,0 +1,7 @@
+from oktopk_tpu.utils.cost_model import (  # noqa: F401
+    allgather_cost,
+    allreduce_cost,
+    sparse_allreduce_cost,
+    topk_cost,
+)
+from oktopk_tpu.utils.logging import get_logger  # noqa: F401

@@ -1,0 +1,29 @@
+"""Hostname-tagged logging + per-experiment log files (reference
+VGG/settings.py:27-38 and the logfile wiring in VGG/main_trainer.py:165-176)."""
+
+from __future__ import annotations
+
+import logging
+import os
+import socket
+from typing import Optional
+
+
+def get_logger(name: str = "oktopk_tpu", logfile: Optional[str] = None,
+               level=logging.INFO) -> logging.Logger:
+    logger = logging.getLogger(name)
+    if logger.handlers:
+        return logger
+    logger.setLevel(level)
+    host = socket.gethostname()
+    fmt = logging.Formatter(
+        f"%(asctime)s [{host}] %(levelname)s %(name)s: %(message)s")
+    sh = logging.StreamHandler()
+    sh.setFormatter(fmt)
+    logger.addHandler(sh)
+    if logfile:
+        os.makedirs(os.path.dirname(logfile), exist_ok=True)
+        fh = logging.FileHandler(logfile)
+        fh.setFormatter(fmt)
+        logger.addHandler(fh)
+    return logger

@@ -1,0 +1,96 @@
+"""End-to-end distributed training smoke tests (M1 of SURVEY.md §7.2: the
+minimum slice is model + data + sparse collective + SGD on a multi-device
+mesh)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from oktopk_tpu.config import TrainConfig
+from oktopk_tpu.data.synthetic import synthetic_iterator
+from oktopk_tpu.train.trainer import Trainer
+
+
+def run_steps(trainer, n, batch_size, seed=0):
+    it = synthetic_iterator(trainer.cfg.dnn, batch_size, seed)
+    out = None
+    for _ in range(n):
+        out = trainer.train_step(next(it))
+    return out
+
+
+class TestMnistOkTopk:
+    @pytest.fixture(scope="class")
+    def trainer(self, mesh4):
+        cfg = TrainConfig(dnn="mnistnet", dataset="mnist", batch_size=8,
+                          lr=0.05, compressor="oktopk", density=0.05)
+        return Trainer(cfg, mesh=mesh4, warmup=False)
+
+    def test_loss_decreases(self, trainer):
+        it = synthetic_iterator("mnistnet", 8, seed=1)
+        first = None
+        # fixed batch -> loss must go down under repeated steps
+        batch = next(it)
+        for i in range(6):
+            m = trainer.train_step(batch)
+            if first is None:
+                first = float(m["loss"])
+        assert np.isfinite(float(m["loss"]))
+        assert float(m["loss"]) < first
+
+    def test_comm_volume_tracked(self, trainer):
+        m = run_steps(trainer, 1, 8, seed=2)
+        assert float(m["comm_volume"]) > 0
+        assert float(m["comm_volume"]) < 2.0 * trainer.algo_cfg.n
+
+    def test_sparse_state_advances(self, trainer):
+        s0 = int(trainer.state.sparse_state.step[0])
+        run_steps(trainer, 2, 8, seed=3)
+        assert int(trainer.state.sparse_state.step[0]) == s0 + 2
+
+
+class TestWorkloads:
+    def test_vgg16_dense_step(self, mesh4):
+        cfg = TrainConfig(dnn="vgg16", dataset="cifar10", batch_size=4,
+                          lr=0.1, compressor="dense")
+        tr = Trainer(cfg, mesh=mesh4, warmup=False)
+        m = run_steps(tr, 2, 4)
+        assert np.isfinite(float(m["loss"]))
+
+    def test_lstm_topka(self, mesh4):
+        cfg = TrainConfig(dnn="lstm", dataset="ptb", batch_size=4,
+                          lr=1.0, compressor="topkA", density=0.05,
+                          grad_clip=0.25)
+        tr = Trainer(cfg, mesh=mesh4, warmup=False,
+                     model_kwargs={"hidden_size": 64, "num_layers": 2})
+        m = run_steps(tr, 2, 4)
+        assert np.isfinite(float(m["loss"]))
+
+    def test_bert_tiny_oktopk(self, mesh4):
+        cfg = TrainConfig(dnn="bert_tiny", dataset="wikipedia", batch_size=4,
+                          lr=1e-3, compressor="oktopk", density=0.05,
+                          total_steps=100)
+        tr = Trainer(cfg, mesh=mesh4, warmup=False)
+        m = run_steps(tr, 2, 4)
+        assert np.isfinite(float(m["loss"]))
+        assert "mlm_loss" not in m or np.isfinite(float(m.get("mlm_loss", 0)))
+
+    def test_grad_accumulation(self, mesh4):
+        cfg = TrainConfig(dnn="mnistnet", dataset="mnist", batch_size=8,
+                          lr=0.05, compressor="gaussiank", density=0.1,
+                          nsteps_update=2)
+        tr = Trainer(cfg, mesh=mesh4, warmup=False)
+        # global batch = workers * nsteps * microbatch
+        m = run_steps(tr, 2, 16)
+        assert np.isfinite(float(m["loss"]))
+
+
+class TestEval:
+    def test_eval_accuracy(self, mesh4):
+        cfg = TrainConfig(dnn="mnistnet", dataset="mnist", batch_size=8,
+                          compressor="dense")
+        tr = Trainer(cfg, mesh=mesh4, warmup=False)
+        it = synthetic_iterator("mnistnet", 16, seed=5)
+        m = tr.eval_step(next(it))
+        assert 0.0 <= float(m["accuracy"]) <= 1.0
