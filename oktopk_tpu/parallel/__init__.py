@@ -1,0 +1,18 @@
+"""Parallelism beyond data-parallel.
+
+The reference is data-parallel only: TP/SP/EP are absent and its PipeDream
+pipeline machinery ships disabled (stage maps commented out, configs
+single-stage — reference BERT/runtime.py:156-273, SURVEY.md §2.3). This
+package carries (a) a working GPipe-style pipeline equivalent to the
+machinery the reference ships (microbatch flushes, recompute), and (b) the
+TPU-first extensions the reference lacks but a TPU framework needs as
+first-class citizens: ring-attention sequence/context parallelism over a
+``seq`` mesh axis. Both are flagged as extensions in docs where they exceed
+reference parity (SURVEY.md §5.7).
+"""
+
+from oktopk_tpu.parallel.ring_attention import (  # noqa: F401
+    ring_attention,
+    ring_self_attention,
+)
+from oktopk_tpu.parallel.pipeline import gpipe_apply  # noqa: F401

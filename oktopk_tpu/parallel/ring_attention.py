@@ -1,0 +1,88 @@
+"""Ring attention: exact attention over a sequence-sharded mesh axis.
+
+Sequence/context parallelism is absent from the reference (max_seq_length is
+a plain flag, attention is vanilla quadratic BertSelfAttention — SURVEY.md
+§5.7); on TPU it is a first-class scaling axis. This is the standard ring
+formulation: queries stay resident, key/value blocks rotate around the ring
+via ``ppermute`` (one ICI hop per step), and softmax is accumulated online
+(running max + normaliser), so the full [T, T] score matrix never
+materialises and sequence length scales linearly with the number of devices.
+
+Pure function, usable inside ``shard_map`` with a ``seq`` axis; wraps into
+``ring_self_attention`` for Flax modules.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+                   axis_name: str, kv_mask: Optional[jnp.ndarray] = None,
+                   scale: Optional[float] = None) -> jnp.ndarray:
+    """Exact softmax attention with K/V ring rotation.
+
+    Args:
+      q, k, v: local shards [B, T_local, H, D].
+      kv_mask: optional [B, T_local] bool — True where the key position is
+        attendable (padding mask). Rotates with k/v.
+      scale: defaults to 1/sqrt(D).
+
+    Returns: [B, T_local, H, D] attention output for the local queries.
+    """
+    P = lax.axis_size(axis_name)
+    d = q.shape[-1]
+    scale = scale if scale is not None else d ** -0.5
+    q = q * scale
+
+    neg = jnp.asarray(-1e30, jnp.float32)
+    B, T, H, _ = q.shape
+    m = jnp.full((B, T, H), neg, jnp.float32)       # running max
+    l = jnp.zeros((B, T, H), jnp.float32)           # running normaliser
+    o = jnp.zeros(q.shape, jnp.float32)             # running output
+
+    if kv_mask is None:
+        kv_mask = jnp.ones(k.shape[:2], bool)
+
+    def body(carry, _):
+        m, l, o, kk, vv, mask = carry
+        # scores for this K/V block: [B, T, H, Tk]
+        s = jnp.einsum("bthd,bshd->bths", q, kk).astype(jnp.float32)
+        s = jnp.where(mask[:, None, None, :], s, neg)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+        corr = jnp.exp(m - m_new)
+        p = jnp.exp(s - m_new[..., None])
+        l_new = l * corr + jnp.sum(p, axis=-1)
+        o_new = o * corr[..., None] + jnp.einsum(
+            "bths,bshd->bthd", p, vv.astype(jnp.float32))
+        # rotate K/V (and their mask) one hop around the ring
+        perm = [(i, (i + 1) % P) for i in range(P)]
+        kk = lax.ppermute(kk, axis_name, perm)
+        vv = lax.ppermute(vv, axis_name, perm)
+        mask = lax.ppermute(mask, axis_name, perm)
+        return (m_new, l_new, o_new, kk, vv, mask), None
+
+    from oktopk_tpu.comm.primitives import pvary_tree
+    init = pvary_tree((m, l, o, k, v, kv_mask), axis_name)
+    (m, l, o, _, _, _), _ = lax.scan(body, init, None, length=P)
+    return (o / jnp.maximum(l, 1e-30)[..., None]).astype(q.dtype)
+
+
+def ring_self_attention(x: jnp.ndarray, wq, wk, wv, wo, num_heads: int,
+                        axis_name: str,
+                        kv_mask: Optional[jnp.ndarray] = None):
+    """Projection + ring attention + output projection (a functional
+    building block for sequence-sharded transformer layers).
+
+    x: [B, T_local, E]; wq/wk/wv: [E, H*D]; wo: [H*D, E].
+    """
+    B, T, E = x.shape
+    D = wq.shape[1] // num_heads
+    proj = lambda w: jnp.einsum("bte,ef->btf", x, w).reshape(B, T, num_heads, D)
+    out = ring_attention(proj(wq), proj(wk), proj(wv), axis_name,
+                         kv_mask=kv_mask)
+    return jnp.einsum("btf,fe->bte", out.reshape(B, T, num_heads * D), wo)
