@@ -38,12 +38,12 @@ from oktopk_tpu.comm import all_gather, all_to_all, axis_rank, psum
 from oktopk_tpu.comm.primitives import pvary_tree
 from oktopk_tpu.config import OkTopkConfig
 from oktopk_tpu.ops import (
-    exact_topk,
-    k2threshold,
     pack_by_region,
     scatter_sparse,
     select_by_threshold,
+    select_mask,
 )
+from oktopk_tpu.ops.topk import k2threshold_method
 from oktopk_tpu.ops.residual import add_residual, update_residual_at_winners
 
 
@@ -99,9 +99,12 @@ def oktopk(grad: jnp.ndarray, state: SparseState, cfg: OkTopkConfig,
     recompute_global = (state.step % cfg.global_recompute_every == 0) | first_sparse
 
     # ---- local threshold: exact every local_recompute_every, else predicted
-    # (reference VGG/allreducer.py:593 vs :696-699).
+    # (reference VGG/allreducer.py:593 vs :696-699). "Exact" uses the
+    # sort-free bisection by default (cfg.threshold_method).
     lt = lax.cond(recompute_local,
-                  lambda: k2threshold(abs_acc, k).astype(acc.dtype),
+                  lambda: k2threshold_method(
+                      abs_acc, k, cfg.threshold_method,
+                      cfg.bisect_iters).astype(acc.dtype),
                   lambda: state.local_threshold)
 
     # ---- region repartition every repartition_every steps (reference :626-654).
@@ -138,14 +141,20 @@ def oktopk(grad: jnp.ndarray, state: SparseState, cfg: OkTopkConfig,
     def exact_branch():
         # Every global_recompute_every steps the reference gathers all
         # nonzeros and takes an exact global top-k (VGG/allreducer.py:819-846).
-        # TPU form: each region contributes its top-k_cand candidates (a
-        # region can hold at most k of the global top-k), exact k-th value of
-        # the gathered pool becomes the new global threshold.
-        vals, idx = exact_topk(reduced, k_cand)
+        # TPU form: each region contributes up to k_cand candidates (a region
+        # can hold at most k of the global top-k) selected by a sort-free
+        # per-region threshold; the k-th value of the gathered pool becomes
+        # the new global threshold. No O(n log n) sort anywhere.
+        t_cand = k2threshold_method(jnp.abs(reduced), k_cand,
+                                    cfg.threshold_method, cfg.bisect_iters)
+        cand_mask = (jnp.abs(reduced) >= t_cand) & (reduced != 0.0)
+        vals, idx, _ = select_mask(reduced, cand_mask, k_cand)
         gv = all_gather(vals, axis_name)               # [P, k_cand]
         gi = all_gather(idx, axis_name)
-        gt = k2threshold(jnp.abs(gv).reshape(-1), k).astype(acc.dtype)
-        keep = jnp.abs(gv) >= gt
+        gt = k2threshold_method(jnp.abs(gv).reshape(-1), k,
+                                cfg.threshold_method,
+                                cfg.bisect_iters).astype(acc.dtype)
+        keep = (jnp.abs(gv) >= gt) & (gi < n)
         result = scatter_sparse(n, jnp.where(keep, gv, 0.0),
                                 jnp.where(keep, gi, n))
         g_count = jnp.sum(keep)

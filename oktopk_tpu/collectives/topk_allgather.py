@@ -20,10 +20,10 @@ from oktopk_tpu.comm import all_gather, psum
 from oktopk_tpu.config import OkTopkConfig
 from oktopk_tpu.ops import (
     exact_topk,
-    k2threshold,
     scatter_sparse,
     select_by_threshold,
 )
+from oktopk_tpu.ops.topk import k2threshold_method
 from oktopk_tpu.ops.residual import add_residual, update_residual_at_selection
 
 
@@ -80,7 +80,9 @@ def topk_a_opt(grad: jnp.ndarray, state: SparseState, cfg: OkTopkConfig,
     recompute = ((state.step % cfg.local_recompute_every == 0)
                  | (state.step == cfg.warmup_steps))  # see oktopk.py
     lt = lax.cond(recompute,
-                  lambda: k2threshold(abs_acc, k).astype(acc.dtype),
+                  lambda: k2threshold_method(
+                      abs_acc, k, cfg.threshold_method,
+                      cfg.bisect_iters).astype(acc.dtype),
                   lambda: state.local_threshold)
 
     vals, idx, count = select_by_threshold(acc, lt, cap)

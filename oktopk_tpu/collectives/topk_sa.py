@@ -25,11 +25,11 @@ from oktopk_tpu.comm.primitives import pvary_tree
 from oktopk_tpu.config import OkTopkConfig
 from oktopk_tpu.ops import (
     gaussian_threshold,
-    k2threshold,
     pack_by_region,
     scatter_sparse,
 )
 from oktopk_tpu.ops.select import select_nonzero
+from oktopk_tpu.ops.topk import k2threshold_method
 from oktopk_tpu.ops.residual import add_residual, update_residual_at_winners
 
 
@@ -98,7 +98,9 @@ def topk_sa(grad: jnp.ndarray, state: SparseState, cfg: OkTopkConfig,
     recompute = ((state.step % cfg.local_recompute_every == 0)
                  | (state.step == cfg.warmup_steps))  # see oktopk.py
     lt = lax.cond(recompute,
-                  lambda: k2threshold(abs_acc, k).astype(acc.dtype),
+                  lambda: k2threshold_method(
+                      abs_acc, k, cfg.threshold_method,
+                      cfg.bisect_iters).astype(acc.dtype),
                   lambda: state.local_threshold)
     result, residual, vol, lc, gc = _split_allreduce(
         acc, lt, state, cfg, axis_name, dense_fallback=True)

@@ -62,6 +62,14 @@ class OkTopkConfig:
     gaussian_refine_iters: int = 16
     sigma_scale: float = 2.5        # reference VGG/vgg16_oktopk.sh:28
 
+    # Exact-threshold implementation for the periodic recomputes:
+    # "bisect" (default, TPU-first): sort-free count-bisection — O(iters*n)
+    #   VPU compares instead of the O(n log n) sort the reference pays for
+    #   torch.topk (SURVEY.md §7.3.5); ties resolved within float tolerance.
+    # "sort": exact lax.top_k (reference-faithful; fine on CPU/small n).
+    threshold_method: str = "bisect"
+    bisect_iters: int = 30
+
     # topkSA density-adaptive fallback: switch to dense allgather when the
     # reduced result is >= this dense (reference VGG/allreducer.py:1318-1351).
     sa_dense_fallback_ratio: float = 2.0 / 3.0

@@ -35,6 +35,17 @@ def k2threshold(x_abs: jnp.ndarray, k: int):
     return vals[k - 1]
 
 
+def k2threshold_method(x_abs: jnp.ndarray, k: int, method: str = "sort",
+                       bisect_iters: int = 30):
+    """Dispatch between the exact sort-based threshold and the sort-free
+    bisection (ops/pallas_topk.py) — selected by
+    ``OkTopkConfig.threshold_method``."""
+    if method == "bisect":
+        from oktopk_tpu.ops.pallas_topk import k2threshold_bisect
+        return k2threshold_bisect(x_abs, k, iters=bisect_iters)
+    return k2threshold(x_abs, k)
+
+
 def ratio2threshold(x: jnp.ndarray, density: float):
     """Exact threshold such that |x| >= t selects ~density*n elements.
 

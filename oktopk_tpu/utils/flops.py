@@ -1,0 +1,31 @@
+"""Model complexity accounting (reference C21: the vendored ptflops
+per-layer MACs/params hooks, BERT/ptflops/flops_counter.py:19-410, reported
+at startup by main_bert.py:861-869).
+
+TPU-native form: XLA already computes a cost model for every compiled
+program; ``jax.jit(...).lower().compile().cost_analysis()`` exposes it, so no
+per-layer hooks are needed and the numbers reflect the *fused* program that
+actually runs."""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax
+
+
+def param_count(params) -> int:
+    return int(sum(x.size for x in jax.tree.leaves(params)))
+
+
+def model_complexity(fn, *args) -> Dict[str, Any]:
+    """Compile ``fn(*args)`` and report XLA's flop/byte estimates."""
+    compiled = jax.jit(fn).lower(*args).compile()
+    cost = compiled.cost_analysis() or {}
+    if isinstance(cost, (list, tuple)):     # older jax returns [dict]
+        cost = cost[0] if cost else {}
+    return {
+        "flops": float(cost.get("flops", -1)),
+        "bytes_accessed": float(cost.get("bytes accessed", -1)),
+        "cost_analysis": dict(cost),
+    }

@@ -1,0 +1,69 @@
+"""Checkpoint/resume tests — including sparse-algorithm state fidelity,
+the reference's known gap (residuals never saved, SURVEY.md §5.4)."""
+
+import numpy as np
+import pytest
+
+from oktopk_tpu.config import TrainConfig
+from oktopk_tpu.data.synthetic import synthetic_iterator
+from oktopk_tpu.train.checkpoint import (
+    latest_checkpoint,
+    restore_checkpoint,
+    save_checkpoint,
+)
+from oktopk_tpu.train.trainer import Trainer
+
+
+@pytest.fixture(scope="module")
+def trained(mesh4):
+    cfg = TrainConfig(dnn="mnistnet", dataset="mnist", batch_size=8,
+                      lr=0.05, compressor="oktopk", density=0.05)
+    tr = Trainer(cfg, mesh=mesh4, warmup=False)
+    it = synthetic_iterator("mnistnet", 8, seed=9)
+    for _ in range(3):
+        tr.train_step(next(it))
+    return tr
+
+
+class TestCheckpoint:
+    def test_roundtrip_full_state(self, trained, tmp_path):
+        path = save_checkpoint(str(tmp_path), trained.state, step=3)
+        assert path.endswith("ckpt-3.msgpack")
+
+        cfg = trained.cfg
+        fresh = Trainer(cfg, mesh=trained.mesh, warmup=False)
+        restored, step = restore_checkpoint(str(tmp_path), fresh.state)
+        assert step == 3
+
+        import jax
+        for a, b in zip(jax.tree.leaves(trained.state),
+                        jax.tree.leaves(restored)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_sparse_state_survives(self, trained, tmp_path):
+        """Residuals + thresholds + step counters restored exactly — the
+        error-feedback state the reference silently resets."""
+        save_checkpoint(str(tmp_path), trained.state, step=3)
+        fresh = Trainer(trained.cfg, mesh=trained.mesh, warmup=False)
+        restored, _ = restore_checkpoint(str(tmp_path), fresh.state)
+        s0, s1 = trained.state.sparse_state, restored.sparse_state
+        assert int(s1.step[0]) == int(s0.step[0]) == 3
+        np.testing.assert_array_equal(np.asarray(s0.residual),
+                                      np.asarray(s1.residual))
+        assert float(np.abs(np.asarray(s0.residual)).sum()) > 0
+        np.testing.assert_array_equal(np.asarray(s0.local_threshold),
+                                      np.asarray(s1.local_threshold))
+
+    def test_training_continues_after_restore(self, trained, tmp_path):
+        save_checkpoint(str(tmp_path), trained.state, step=3)
+        fresh = Trainer(trained.cfg, mesh=trained.mesh, warmup=False)
+        fresh.state, _ = restore_checkpoint(str(tmp_path), fresh.state)
+        it = synthetic_iterator("mnistnet", 8, seed=10)
+        m = fresh.train_step(next(it))
+        assert np.isfinite(float(m["loss"]))
+        assert int(fresh.state.sparse_state.step[0]) == 4
+
+    def test_latest_checkpoint_picks_max(self, trained, tmp_path):
+        save_checkpoint(str(tmp_path), trained.state, step=3)
+        save_checkpoint(str(tmp_path), trained.state, step=10)
+        assert latest_checkpoint(str(tmp_path)).endswith("ckpt-10.msgpack")
