@@ -1,0 +1,80 @@
+"""Standalone sparse-allreduce micro-benchmark.
+
+Reference C26 analogue: ``benchmark_gtopk_sparse_allreduce``
+(VGG/allreducer.py:1649-1677, run as ``python -m mpi4py allreducer.py`` on
+random 25M-float tensors) and the two-process collective timing scripts
+under BERT/tests/communication/.
+
+Usage:
+    python -m oktopk_tpu.benchmarks.collectives --algo oktopk --n 1048576 \\
+        --density 0.01 --steps 10 [--fake-devices 8]
+
+Prints per-step wall time, comm volume, and EPS vs dense.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--algo", default="oktopk")
+    p.add_argument("--n", type=int, default=1 << 20)
+    p.add_argument("--density", type=float, default=0.01)
+    p.add_argument("--steps", type=int, default=10)
+    p.add_argument("--fake-devices", type=int, default=0)
+    p.add_argument("--local-recompute-every", type=int, default=1)
+    p.add_argument("--global-recompute-every", type=int, default=4)
+    args = p.parse_args(argv)
+
+    if args.fake_devices:
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + f" --xla_force_host_platform_device_count={args.fake_devices}")
+    import jax
+    if args.fake_devices:
+        jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+    import numpy as np
+
+    from oktopk_tpu.collectives.api import (
+        batched_init_state, build_allreduce_step, eps_vs_dense)
+    from oktopk_tpu.comm.mesh import get_mesh
+    from oktopk_tpu.config import OkTopkConfig
+
+    P = len(jax.devices())
+    mesh = get_mesh((P,), ("data",))
+    cfg = OkTopkConfig(
+        n=args.n, num_workers=P, density=args.density, warmup_steps=0,
+        local_recompute_every=args.local_recompute_every,
+        global_recompute_every=args.global_recompute_every)
+    step = build_allreduce_step(args.algo, cfg, mesh, warmup=False)
+    state = batched_init_state(cfg)
+
+    rng = np.random.RandomState(0)
+    base = rng.randn(P, args.n).astype(np.float32)
+    grads = jnp.asarray(base)
+    out, state = step(grads, state)           # compile
+    jax.block_until_ready(out)
+    print(f"algo={args.algo} n={args.n} P={P} k={cfg.k} "
+          f"device={jax.devices()[0].platform}")
+    for i in range(args.steps):
+        grads = jnp.asarray(
+            base + 0.3 * rng.randn(P, args.n).astype(np.float32))
+        t0 = time.time()
+        out, state = step(grads, state)
+        jax.block_until_ready(out)
+        dt = time.time() - t0
+        eps = float(eps_vs_dense(jnp.mean(grads, 0), out[0]))
+        print(f"step {i}: {dt * 1e3:8.2f} ms  "
+              f"volume {float(state.last_volume[0]):10.0f} elems  "
+              f"eps_vs_dense {eps:.4f}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

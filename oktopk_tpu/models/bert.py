@@ -132,6 +132,23 @@ class BertModel(nn.Module):
         return self.embeddings.variables["params"]["word_embeddings"]["embedding"]
 
 
+class BertForSequenceClassification(nn.Module):
+    """Pooled-output classifier/regressor head for GLUE fine-tuning
+    (reference compute_glue_scores.py uses the HF classification head over
+    the same pooler)."""
+    cfg: BertConfig
+    num_labels: int = 2
+
+    @nn.compact
+    def __call__(self, input_ids, token_type_ids=None, attention_mask=None,
+                 train: bool = True):
+        _, pooled = BertModel(self.cfg, name="bert")(
+            input_ids, token_type_ids, attention_mask, train)
+        x = nn.Dropout(self.cfg.dropout, deterministic=not train)(pooled)
+        logits = nn.Dense(self.num_labels, dtype=self.cfg.dtype)(x)
+        return logits.astype(jnp.float32)
+
+
 class BertForPreTraining(nn.Module):
     """MLM + NSP heads over BertModel; MLM decoder tied to the word
     embedding table (reference modeling.py BertPreTrainingHeads)."""

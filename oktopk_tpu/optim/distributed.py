@@ -68,6 +68,7 @@ def build_sparse_grad_step(
     nsteps_update: int = 1,
     grad_clip: Optional[float] = None,
     warmup: bool = True,
+    profile_norm: bool = False,
 ):
     """Build the jitted distributed train step.
 
@@ -81,6 +82,9 @@ def build_sparse_grad_step(
         (reference VGG/main_trainer.py:85-89).
       grad_clip: optional global-norm clip applied to the *local* grad before
         the allreduce (reference LSTM/main_trainer.py:94-99).
+      profile_norm: add an ``eps_vs_dense`` metric — the reference's
+        PROFILING_NORM instrumentation (EPS = ‖dense−sparse‖₂/‖dense‖₂,
+        VGG/allreducer.py:1072-1080). Costs one extra dense pmean per step.
 
     Returns ``step(state: DistTrainState, batch, rng) -> (state, metrics)``.
     ``batch`` leaves are [num_workers * nsteps_update * mb, ...] and get
@@ -138,6 +142,11 @@ def build_sparse_grad_step(
             "local_k": sparse.last_local_count,
             "global_k": sparse.last_global_count,
         }
+        if profile_norm:
+            dense = lax.pmean(flat, axis_name)
+            metrics["eps_vs_dense"] = (
+                jnp.linalg.norm(dense - reduced)
+                / (jnp.linalg.norm(dense) + 1e-12))
         new_state = DistTrainState(
             params=params, model_state=model_state, opt_state=opt_state,
             sparse_state=jax.tree.map(lambda x: x[None], sparse))

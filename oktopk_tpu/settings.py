@@ -1,0 +1,30 @@
+"""Global debug/profiling flags (reference VGG/settings.py:1-39: DEBUG,
+SPARSE, WARMUP, PROFILING, PROFILING_NORM, PROFILING_GRAD, TENSORBOARD
+module-level switches).
+
+Unlike the reference these do not silently change hot-path behaviour at
+import time; they are read once where the relevant feature is built:
+
+- ``PROFILING_NORM`` -> ``build_sparse_grad_step(profile_norm=True)`` adds an
+  ``eps_vs_dense`` metric (runs a dense pmean alongside the sparse collective
+  every step, like reference VGG/allreducer.py:584-606,1072-1080);
+- ``PROFILING`` -> the trainer logs per-step selection counts/thresholds
+  (always present in metrics; this flag widens log verbosity);
+- ``PROFILING_GRAD`` -> drivers dump flat-gradient .npy snapshots.
+
+Env overrides: OKTOPK_DEBUG / OKTOPK_PROFILING / OKTOPK_PROFILING_NORM.
+"""
+
+import os
+
+
+def _env_flag(name: str, default: bool = False) -> bool:
+    v = os.environ.get(name)
+    return default if v is None else v.lower() in ("1", "true", "yes")
+
+
+DEBUG = _env_flag("OKTOPK_DEBUG")
+PROFILING = _env_flag("OKTOPK_PROFILING")
+PROFILING_NORM = _env_flag("OKTOPK_PROFILING_NORM")
+PROFILING_GRAD = _env_flag("OKTOPK_PROFILING_GRAD")
+TENSORBOARD = _env_flag("OKTOPK_TENSORBOARD")

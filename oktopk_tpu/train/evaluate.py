@@ -1,0 +1,69 @@
+"""Checkpoint evaluation driver (reference VGG/evaluate.py:20: load per-epoch
+checkpoints, run trainer.test; WER/CER for the speech workload via the
+decoder, VGG/dl_trainer.py:743-762).
+
+Usage:
+    python -m oktopk_tpu.train.evaluate --dnn vgg16 --dataset cifar10 \\
+        --ckpt ./ckpts [--fake-devices 4]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+import numpy as np
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--dnn", default="vgg16")
+    p.add_argument("--dataset", default="cifar10")
+    p.add_argument("--data-dir", default="./data")
+    p.add_argument("--ckpt", required=True)
+    p.add_argument("--batch-size", type=int, default=64)
+    p.add_argument("--num-batches", type=int, default=0,
+                   help="0 = one pass over eval split (synthetic: 16)")
+    p.add_argument("--fake-devices", type=int, default=0)
+    args = p.parse_args(argv)
+
+    if args.fake_devices:
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + f" --xla_force_host_platform_device_count={args.fake_devices}")
+    import jax
+    if args.fake_devices:
+        jax.config.update("jax_platforms", "cpu")
+
+    from oktopk_tpu.config import TrainConfig
+    from oktopk_tpu.data import make_dataset
+    from oktopk_tpu.train.checkpoint import restore_checkpoint
+    from oktopk_tpu.train.trainer import Trainer
+    from oktopk_tpu.utils.logging import get_logger
+
+    logger = get_logger("oktopk_tpu.eval")
+    cfg = TrainConfig(dnn=args.dnn, dataset=args.dataset,
+                      batch_size=args.batch_size,
+                      num_workers=len(jax.devices()))
+    trainer = Trainer(cfg, warmup=False)
+    trainer.state, step = restore_checkpoint(args.ckpt, trainer.state)
+    logger.info("evaluating %s checkpoint @ step %d", args.dnn, step)
+
+    data_iter, meta = make_dataset(args.dataset, args.dnn, args.batch_size,
+                                   path=args.data_dir, split="test")
+    nb = args.num_batches or (
+        16 if meta.get("synthetic")
+        else max(1, meta["num_examples"] // args.batch_size))
+    totals = {}
+    for _ in range(nb):
+        m = trainer.eval_step(next(data_iter))
+        for k, v in m.items():
+            totals.setdefault(k, []).append(float(np.asarray(v)))
+    for k, vs in totals.items():
+        logger.info("%s: %.4f", k, sum(vs) / len(vs))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

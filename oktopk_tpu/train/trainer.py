@@ -45,7 +45,11 @@ class Trainer:
     def __init__(self, cfg: TrainConfig, mesh: Optional[Mesh] = None,
                  algo_cfg: Optional[OkTopkConfig] = None,
                  model_kwargs: Optional[Dict[str, Any]] = None,
-                 axis_name: str = "data", warmup: bool = True):
+                 axis_name: str = "data", warmup: bool = True,
+                 profile_norm: Optional[bool] = None):
+        from oktopk_tpu import settings
+        if profile_norm is None:
+            profile_norm = settings.PROFILING_NORM
         self.cfg = cfg
         self.mesh = mesh if mesh is not None else get_mesh()
         self.axis_name = axis_name
@@ -84,7 +88,7 @@ class Trainer:
             self._loss_fn, self.optimizer, self.algo_cfg, self.mesh,
             compressor=cfg.compressor, axis_name=axis_name,
             nsteps_update=cfg.nsteps_update, grad_clip=cfg.grad_clip,
-            warmup=warmup)
+            warmup=warmup, profile_norm=profile_norm)
         self._rng = jax.random.PRNGKey(cfg.seed + 1)
         self.metrics_history = []
 

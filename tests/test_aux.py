@@ -1,0 +1,145 @@
+"""Tests for aux subsystems: tokenizer, GLUE metrics, decoder/WER, cost
+models, data loaders, profiling metric."""
+
+import numpy as np
+import pytest
+
+from oktopk_tpu.data.loaders import make_dataset
+from oktopk_tpu.data.tokenization import FullTokenizer
+from oktopk_tpu.train.glue import (
+    TASKS,
+    f1_score,
+    matthews_corr,
+    pearson,
+    spearman,
+    task_metrics,
+)
+from oktopk_tpu.utils.cost_model import (
+    allgather_cost,
+    allreduce_cost,
+    sparse_allreduce_cost,
+)
+from oktopk_tpu.utils.decoder import GreedyDecoder, levenshtein
+
+
+class TestTokenizer:
+    def test_basic_split(self):
+        tok = FullTokenizer()
+        assert tok.basic.tokenize("Hello, world!") == \
+            ["hello", ",", "world", "!"]
+
+    def test_encode_pair_layout(self):
+        tok = FullTokenizer()
+        ids, types, mask = tok.encode_pair("a b", "c", max_len=8)
+        assert len(ids) == len(types) == len(mask) == 8
+        assert ids[0] == tok.vocab["[CLS]"]
+        assert sum(mask) == 6          # CLS a b SEP c SEP
+        assert types[:4] == [0, 0, 0, 0] and types[4] == 1
+
+    def test_pair_truncation(self):
+        tok = FullTokenizer()
+        long_a = " ".join(["w%d" % i for i in range(50)])
+        ids, _, mask = tok.encode_pair(long_a, "x y", max_len=16)
+        assert len(ids) == 16 and sum(mask) == 16
+
+    def test_wordpiece_with_vocab(self, tmp_path):
+        vocab = tmp_path / "vocab.txt"
+        vocab.write_text("\n".join(
+            ["[PAD]", "[UNK]", "[CLS]", "[SEP]", "[MASK]",
+             "un", "##aff", "##able", "hello"]))
+        tok = FullTokenizer(str(vocab))
+        assert tok.tokenize("unaffable") == ["un", "##aff", "##able"]
+        assert tok.tokenize("hello unknown") == ["hello", "[UNK]"]
+
+
+class TestGlueMetrics:
+    def test_matthews_perfect(self):
+        y = np.array([0, 1, 1, 0])
+        assert matthews_corr(y, y) == pytest.approx(1.0)
+
+    def test_f1(self):
+        yt = np.array([1, 1, 0, 0])
+        yp = np.array([1, 0, 1, 0])
+        assert f1_score(yt, yp) == pytest.approx(0.5)
+
+    def test_pearson_spearman(self):
+        a = np.array([1.0, 2.0, 3.0, 4.0])
+        assert pearson(a, 2 * a + 1) == pytest.approx(1.0)
+        assert spearman(a, a ** 3) == pytest.approx(1.0)
+
+    def test_task_metric_dispatch(self):
+        y = np.array([0, 1])
+        assert "matthews" in task_metrics(TASKS["cola"], y, y)
+        assert "f1" in task_metrics(TASKS["mrpc"], y, y)
+        assert "pearson" in task_metrics(
+            TASKS["sts-b"], y.astype(float), y.astype(float))
+
+    def test_all_nine_tasks_defined(self):
+        assert set(TASKS) == {"cola", "sst-2", "mrpc", "sts-b", "qqp",
+                              "mnli", "qnli", "rte", "wnli"}
+
+
+class TestDecoder:
+    def test_levenshtein(self):
+        assert levenshtein("kitten", "sitting") == 3
+
+    def test_greedy_collapse(self):
+        labels = "_ab"   # blank at 0
+        dec = GreedyDecoder(labels)
+        logits = np.zeros((1, 5, 3))
+        for t, c in enumerate([1, 1, 0, 2, 2]):   # a a _ b b -> "ab"
+            logits[0, t, c] = 1.0
+        assert dec.decode(logits) == ["ab"]
+
+    def test_wer(self):
+        assert GreedyDecoder.wer("a b c", "a x c") == pytest.approx(1 / 3)
+
+
+class TestCostModel:
+    def test_sparse_beats_dense_at_low_density(self):
+        n, p = 10_000_000, 32
+        k = n // 100
+        assert sparse_allreduce_cost(k, p) < allreduce_cost(n, p)
+
+    def test_allgather_scales_with_p(self):
+        assert allgather_cost(1000, 32) > allgather_cost(1000, 4)
+
+
+class TestLoaders:
+    def test_synthetic_fallback(self, tmp_path):
+        it, meta = make_dataset("cifar10", "vgg16", 8,
+                                path=str(tmp_path))
+        assert meta["synthetic"]
+        b = next(it)
+        assert b["image"].shape == (8, 32, 32, 3)
+
+    def test_mnist_real_files(self, tmp_path):
+        rng = np.random.RandomState(0)
+        imgs = rng.randint(0, 255, (32, 28, 28), np.uint8)
+        labels = rng.randint(0, 10, 32).astype(np.uint8)
+        import struct
+        with open(tmp_path / "train-images-idx3-ubyte", "wb") as f:
+            f.write(struct.pack(">IIII", 2051, 32, 28, 28))
+            f.write(imgs.tobytes())
+        with open(tmp_path / "train-labels-idx1-ubyte", "wb") as f:
+            f.write(struct.pack(">II", 2049, 32))
+            f.write(labels.tobytes())
+        it, meta = make_dataset("mnist", "mnistnet", 8, path=str(tmp_path))
+        assert not meta["synthetic"]
+        assert meta["num_examples"] == 32
+        b = next(it)
+        assert b["image"].shape == (8, 28, 28, 1)
+
+    def test_ptb_real_files(self, tmp_path):
+        d = tmp_path / "ptb"
+        d.mkdir()
+        text = "the quick brown fox jumps over the lazy dog " * 40
+        (d / "ptb.train.txt").write_text(text)
+        it, meta = make_dataset("ptb", "lstm", 4, path=str(tmp_path))
+        assert not meta["synthetic"]
+        b = next(it)
+        assert b["tokens"].shape == (4, 35)
+        # targets are tokens shifted by one
+        flat_t = b["tokens"].reshape(-1)
+        flat_y = b["targets"].reshape(-1)
+        assert flat_t.dtype == np.int32 and flat_y.dtype == np.int32
