@@ -90,6 +90,30 @@ def make_dataset(dataset: str, dnn: str, batch_size: int,
     data when files are absent."""
     path = path or os.environ.get("OKTOPK_DATA_DIR", "./data")
     try:
+        if dataset == "wikipedia":
+            from oktopk_tpu.data.bert_pretrain import pretrain_iterator
+            from oktopk_tpu.data.tokenization import FullTokenizer
+            corpus = os.path.join(path, "wikipedia")
+            if not os.path.exists(corpus):
+                raise FileNotFoundError(corpus)
+            vocab_file = os.path.join(path, "vocab.txt")
+            tok = FullTokenizer(
+                vocab_file if os.path.exists(vocab_file) else None)
+            vocab_size = 1024 if dnn == "bert_tiny" else 30522
+            seq = 32 if dnn == "bert_tiny" else 128
+            return (pretrain_iterator(corpus, tok, batch_size, seq,
+                                      seed, vocab_size),
+                    {"synthetic": False, "num_examples": 50000})
+        if dataset == "an4":
+            from oktopk_tpu.data.audio import an4_iterator
+            manifest = os.path.join(
+                path, "an4_train_manifest.csv" if split == "train"
+                else "an4_val_manifest.csv")
+            if not os.path.exists(manifest):
+                raise FileNotFoundError(manifest)
+            it = an4_iterator(manifest, batch_size, seed=seed,
+                              shuffle=split == "train")
+            return it, {"synthetic": False, "num_examples": 948}
         if dataset == "cifar10":
             arrays = load_cifar10(path, split)
         elif dataset == "mnist":

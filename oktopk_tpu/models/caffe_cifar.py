@@ -1,0 +1,30 @@
+"""Caffe's classic cifar10_quick net (reference VGG/models/caffe_cifar.py:
+3 conv-pool stages + 2 dense layers)."""
+
+from __future__ import annotations
+
+from typing import Any
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class CaffeCifar(nn.Module):
+    num_classes: int = 10
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        x = nn.Conv(32, (5, 5), padding=2, dtype=self.dtype)(x)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=((0, 1), (0, 1)))
+        x = nn.relu(x)
+        x = nn.Conv(32, (5, 5), padding=2, dtype=self.dtype)(x)
+        x = nn.relu(x)
+        x = nn.avg_pool(x, (3, 3), strides=(2, 2), padding=((0, 1), (0, 1)))
+        x = nn.Conv(64, (5, 5), padding=2, dtype=self.dtype)(x)
+        x = nn.relu(x)
+        x = nn.avg_pool(x, (3, 3), strides=(2, 2), padding=((0, 1), (0, 1)))
+        x = x.reshape((x.shape[0], -1))
+        x = nn.Dense(64, dtype=self.dtype)(x)
+        x = nn.Dense(self.num_classes, dtype=self.dtype)(x)
+        return x.astype(jnp.float32)

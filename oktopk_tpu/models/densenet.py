@@ -1,0 +1,60 @@
+"""DenseNet for CIFAR (reference VGG/models/densenet.py: dense blocks with
+growth-rate concatenation, bottleneck option, transition compression)."""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class DenseLayer(nn.Module):
+    growth_rate: int
+    bottleneck: bool = True
+    dtype: Any = jnp.float32
+    axis_name: Optional[str] = None
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        bn = lambda: nn.BatchNorm(use_running_average=not train, momentum=0.9,
+                                  dtype=self.dtype, axis_name=self.axis_name)
+        y = nn.relu(bn()(x))
+        if self.bottleneck:
+            y = nn.Conv(4 * self.growth_rate, (1, 1), use_bias=False,
+                        dtype=self.dtype)(y)
+            y = nn.relu(bn()(y))
+        y = nn.Conv(self.growth_rate, (3, 3), padding=1, use_bias=False,
+                    dtype=self.dtype)(y)
+        return jnp.concatenate([x, y], axis=-1)
+
+
+class DenseNet(nn.Module):
+    depth: int = 100
+    growth_rate: int = 12
+    compression: float = 0.5
+    num_classes: int = 10
+    dtype: Any = jnp.float32
+    axis_name: Optional[str] = None
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        bn = lambda: nn.BatchNorm(use_running_average=not train, momentum=0.9,
+                                  dtype=self.dtype, axis_name=self.axis_name)
+        n = (self.depth - 4) // 6       # layers per block (bottleneck)
+        x = nn.Conv(2 * self.growth_rate, (3, 3), padding=1, use_bias=False,
+                    dtype=self.dtype)(x)
+        for block in range(3):
+            for _ in range(n):
+                x = DenseLayer(self.growth_rate, dtype=self.dtype,
+                               axis_name=self.axis_name)(x, train)
+            if block < 2:
+                x = nn.relu(bn()(x))
+                out_ch = int(x.shape[-1] * self.compression)
+                x = nn.Conv(out_ch, (1, 1), use_bias=False,
+                            dtype=self.dtype)(x)
+                x = nn.avg_pool(x, (2, 2), strides=(2, 2))
+        x = nn.relu(bn()(x))
+        x = jnp.mean(x, axis=(1, 2))
+        x = nn.Dense(self.num_classes, dtype=self.dtype)(x)
+        return x.astype(jnp.float32)

@@ -1,0 +1,57 @@
+"""Pre-activation ResNet for CIFAR (reference VGG/models/preresnet.py:
+BN-ReLU-Conv ordering, identity shortcuts)."""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class PreActBlock(nn.Module):
+    filters: int
+    strides: int = 1
+    dtype: Any = jnp.float32
+    axis_name: Optional[str] = None
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        bn = lambda: nn.BatchNorm(use_running_average=not train, momentum=0.9,
+                                  dtype=self.dtype, axis_name=self.axis_name)
+        y = nn.relu(bn()(x))
+        shortcut = x
+        if x.shape[-1] != self.filters or self.strides != 1:
+            shortcut = nn.Conv(self.filters, (1, 1), strides=self.strides,
+                               use_bias=False, dtype=self.dtype)(y)
+        y = nn.Conv(self.filters, (3, 3), strides=self.strides, padding=1,
+                    use_bias=False, dtype=self.dtype)(y)
+        y = nn.relu(bn()(y))
+        y = nn.Conv(self.filters, (3, 3), padding=1, use_bias=False,
+                    dtype=self.dtype)(y)
+        return shortcut + y
+
+
+class PreResNet(nn.Module):
+    depth: int = 110
+    num_classes: int = 10
+    dtype: Any = jnp.float32
+    axis_name: Optional[str] = None
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        assert (self.depth - 2) % 6 == 0
+        n = (self.depth - 2) // 6
+        x = nn.Conv(16, (3, 3), padding=1, use_bias=False,
+                    dtype=self.dtype)(x)
+        for stage, filters in enumerate([16, 32, 64]):
+            for block in range(n):
+                strides = 2 if stage > 0 and block == 0 else 1
+                x = PreActBlock(filters, strides, self.dtype,
+                                self.axis_name)(x, train)
+        x = nn.BatchNorm(use_running_average=not train, momentum=0.9,
+                         dtype=self.dtype, axis_name=self.axis_name)(x)
+        x = nn.relu(x)
+        x = jnp.mean(x, axis=(1, 2))
+        x = nn.Dense(self.num_classes, dtype=self.dtype)(x)
+        return x.astype(jnp.float32)

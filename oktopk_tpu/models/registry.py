@@ -9,6 +9,10 @@ from typing import Any, Callable, Dict, Tuple
 import jax.numpy as jnp
 
 from oktopk_tpu.models.alexnet import AlexNet
+from oktopk_tpu.models.caffe_cifar import CaffeCifar
+from oktopk_tpu.models.densenet import DenseNet
+from oktopk_tpu.models.preresnet import PreResNet
+from oktopk_tpu.models.resnext import ResNeXt
 from oktopk_tpu.models.bert import BertConfig, BertForPreTraining
 from oktopk_tpu.models.deepspeech import DeepSpeech
 from oktopk_tpu.models.imagenet_resnet import ResNet50
@@ -34,6 +38,13 @@ MODELS: Dict[str, Callable[..., Tuple[Any, Callable]]] = {
     "resnet110": lambda **kw: (CifarResNet(depth=110, **kw), _img(32, 32, 3)),
     "resnet50": lambda **kw: (ResNet50(**kw), _img(224, 224, 3)),
     "alexnet": lambda **kw: (AlexNet(**kw), _img(32, 32, 3)),
+    "densenet100": lambda **kw: (DenseNet(**{"depth": 100, **kw}),
+                                 _img(32, 32, 3)),
+    "preresnet110": lambda **kw: (PreResNet(**{"depth": 110, **kw}),
+                                  _img(32, 32, 3)),
+    "resnext29": lambda **kw: (ResNeXt(**{"depth": 29, **kw}),
+                               _img(32, 32, 3)),
+    "caffe_cifar": lambda **kw: (CaffeCifar(**kw), _img(32, 32, 3)),
     "mnistnet": lambda **kw: (MnistNet(**kw), _img(28, 28, 1)),
     "lstm": lambda **kw: (PTBLSTM(**kw), _tokens(35, 10000)),
     "lstman4": lambda **kw: (DeepSpeech(**kw),

@@ -1,0 +1,66 @@
+"""ResNeXt for CIFAR (reference VGG/models/resnext.py: grouped-convolution
+bottleneck blocks, cardinality x base-width)."""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class ResNeXtBlock(nn.Module):
+    filters: int            # output channels
+    cardinality: int = 8
+    base_width: int = 64
+    strides: int = 1
+    dtype: Any = jnp.float32
+    axis_name: Optional[str] = None
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        bn = lambda: nn.BatchNorm(use_running_average=not train, momentum=0.9,
+                                  dtype=self.dtype, axis_name=self.axis_name)
+        width = self.cardinality * self.base_width * self.filters // 256
+        y = nn.Conv(width, (1, 1), use_bias=False, dtype=self.dtype)(x)
+        y = nn.relu(bn()(y))
+        y = nn.Conv(width, (3, 3), strides=self.strides, padding=1,
+                    feature_group_count=self.cardinality, use_bias=False,
+                    dtype=self.dtype)(y)
+        y = nn.relu(bn()(y))
+        y = nn.Conv(self.filters, (1, 1), use_bias=False, dtype=self.dtype)(y)
+        y = bn()(y)
+        shortcut = x
+        if x.shape[-1] != self.filters or self.strides != 1:
+            shortcut = nn.Conv(self.filters, (1, 1), strides=self.strides,
+                               use_bias=False, dtype=self.dtype)(x)
+            shortcut = bn()(shortcut)
+        return nn.relu(y + shortcut)
+
+
+class ResNeXt(nn.Module):
+    depth: int = 29
+    cardinality: int = 8
+    base_width: int = 64
+    num_classes: int = 10
+    dtype: Any = jnp.float32
+    axis_name: Optional[str] = None
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        assert (self.depth - 2) % 9 == 0
+        n = (self.depth - 2) // 9
+        x = nn.Conv(64, (3, 3), padding=1, use_bias=False,
+                    dtype=self.dtype)(x)
+        x = nn.BatchNorm(use_running_average=not train, momentum=0.9,
+                         dtype=self.dtype, axis_name=self.axis_name)(x)
+        x = nn.relu(x)
+        for stage, filters in enumerate([256, 512, 1024]):
+            for block in range(n):
+                strides = 2 if stage > 0 and block == 0 else 1
+                x = ResNeXtBlock(filters, self.cardinality, self.base_width,
+                                 strides, self.dtype,
+                                 self.axis_name)(x, train)
+        x = jnp.mean(x, axis=(1, 2))
+        x = nn.Dense(self.num_classes, dtype=self.dtype)(x)
+        return x.astype(jnp.float32)
