@@ -141,6 +141,10 @@ class TrainConfig:
     num_workers: int = 1
     # LSTM-only gradient clipping (reference LSTM/main_trainer.py:94-99).
     grad_clip: Optional[float] = None
+    # DGC-style momentum correction: fold momentum into the local gradient
+    # stream before compression (reference VGG/distributed_optimizer.py:56,
+    # 81-88); the base optimizer then runs momentum-free.
+    momentum_correction: bool = False
     # BERT-style warmup-linear schedule knobs (transformers/optimization.py).
     warmup_proportion: float = 0.01
     total_steps: int = 0

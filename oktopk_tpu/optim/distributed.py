@@ -37,11 +37,13 @@ from oktopk_tpu.config import OkTopkConfig
 @flax.struct.dataclass
 class DistTrainState:
     """Replicated training state + per-worker sparse state (leading device
-    axis on every SparseState leaf)."""
+    axis on every SparseState leaf). ``local_momentum`` is the per-worker
+    flat momentum buffer used only under momentum correction."""
     params: Any
     model_state: Any          # e.g. flax batch_stats collection
     opt_state: Any
     sparse_state: SparseState
+    local_momentum: Any = None
 
 
 def flat_size(params) -> int:
@@ -49,13 +51,16 @@ def flat_size(params) -> int:
 
 
 def init_dist_state(params, model_state, optimizer, cfg: OkTopkConfig,
-                    dtype=jnp.float32) -> DistTrainState:
+                    dtype=jnp.float32,
+                    momentum_correction: bool = False) -> DistTrainState:
     s = init_state(cfg, dtype)
     s = jax.tree.map(
         lambda x: jnp.broadcast_to(x, (cfg.num_workers,) + x.shape), s)
+    mom = (jnp.zeros((cfg.num_workers, cfg.n), dtype)
+           if momentum_correction else None)
     return DistTrainState(params=params, model_state=model_state,
                           opt_state=optimizer.init(params),
-                          sparse_state=s)
+                          sparse_state=s, local_momentum=mom)
 
 
 def build_sparse_grad_step(
@@ -69,6 +74,7 @@ def build_sparse_grad_step(
     grad_clip: Optional[float] = None,
     warmup: bool = True,
     profile_norm: bool = False,
+    momentum_correction: float = 0.0,
 ):
     """Build the jitted distributed train step.
 
@@ -85,6 +91,11 @@ def build_sparse_grad_step(
       profile_norm: add an ``eps_vs_dense`` metric — the reference's
         PROFILING_NORM instrumentation (EPS = ‖dense−sparse‖₂/‖dense‖₂,
         VGG/allreducer.py:1072-1080). Costs one extra dense pmean per step.
+      momentum_correction: DGC-style local momentum factor applied BEFORE
+        compression (reference _DistributedOptimizer's momentum-correction
+        option, VGG/distributed_optimizer.py:56,81-88). The optimizer should
+        then be momentum-free SGD, since momentum is already folded into the
+        compressed gradient stream.
 
     Returns ``step(state: DistTrainState, batch, rng) -> (state, metrics)``.
     ``batch`` leaves are [num_workers * nsteps_update * mb, ...] and get
@@ -127,6 +138,12 @@ def build_sparse_grad_step(
         flat, unravel = ravel_pytree(grads)
         assert flat.size == cfg.n, (
             f"cfg.n={cfg.n} != flat grad size {flat.size}")
+        if momentum_correction:
+            mom = momentum_correction * state.local_momentum[0] + flat
+            flat = mom
+            new_momentum = mom[None]
+        else:
+            new_momentum = state.local_momentum
         reduced, sparse = algo(flat, sparse, cfg, axis_name)
         grads = unravel(reduced)
 
@@ -149,12 +166,14 @@ def build_sparse_grad_step(
                 / (jnp.linalg.norm(dense) + 1e-12))
         new_state = DistTrainState(
             params=params, model_state=model_state, opt_state=opt_state,
-            sparse_state=jax.tree.map(lambda x: x[None], sparse))
+            sparse_state=jax.tree.map(lambda x: x[None], sparse),
+            local_momentum=new_momentum)
         return new_state, metrics
 
     state_specs = DistTrainState(
         params=P(), model_state=P(), opt_state=P(),
-        sparse_state=P(axis_name))
+        sparse_state=P(axis_name),
+        local_momentum=P(axis_name) if momentum_correction else None)
     mapped = jax.shard_map(
         shard_fn, mesh=mesh,
         in_specs=(state_specs, P(axis_name), P()),

@@ -78,17 +78,25 @@ class Trainer:
                 lr=cfg.lr, warmup=cfg.warmup_proportion,
                 t_total=cfg.total_steps or -1)
         else:
-            self.optimizer = sgd(cfg.lr, momentum=cfg.momentum,
-                                 weight_decay=cfg.weight_decay,
-                                 nesterov=cfg.nesterov)
+            # with momentum correction the momentum lives in the compressed
+            # gradient stream, so the base SGD runs momentum-free
+            self.optimizer = sgd(
+                cfg.lr,
+                momentum=0.0 if cfg.momentum_correction else cfg.momentum,
+                weight_decay=cfg.weight_decay, nesterov=cfg.nesterov)
 
-        self.state = init_dist_state(params, self.model_state,
-                                     self.optimizer, self.algo_cfg)
+        self._warmup = warmup
+        self._profile_norm = profile_norm
+        self.state = init_dist_state(
+            params, self.model_state, self.optimizer, self.algo_cfg,
+            momentum_correction=cfg.momentum_correction)
         self.step_fn = build_sparse_grad_step(
             self._loss_fn, self.optimizer, self.algo_cfg, self.mesh,
             compressor=cfg.compressor, axis_name=axis_name,
             nsteps_update=cfg.nsteps_update, grad_clip=cfg.grad_clip,
-            warmup=warmup, profile_norm=profile_norm)
+            warmup=warmup, profile_norm=profile_norm,
+            momentum_correction=(cfg.momentum
+                                 if cfg.momentum_correction else 0.0))
         self._rng = jax.random.PRNGKey(cfg.seed + 1)
         self.metrics_history = []
 
@@ -189,6 +197,40 @@ class Trainer:
         self.metrics_history.append(
             {k: float(np.asarray(v).mean()) for k, v in metrics.items()})
         return metrics
+
+    # ---- elasticity ---------------------------------------------------
+
+    def resize_workers(self, new_mesh: Mesh):
+        """Rebuild the distributed step for a new world size, keeping model
+        and optimizer state.
+
+        Reference analogue: the elastic hooks ``err_callback`` ->
+        ``trainer.update_nworker`` which rebuild samplers/loaders for a new
+        world size (VGG/main_trainer.py:42-44, VGG/dl_trainer.py:472-493 —
+        detection itself is absent there too; on TPU world changes come from
+        the orchestrator re-invoking with a different mesh). Per-worker
+        algorithm state (residuals, boundaries) is re-initialised for the
+        new topology; replicated state carries over.
+        """
+        num_workers = int(new_mesh.shape[self.axis_name])
+        self.mesh = new_mesh
+        self.cfg = self.cfg.__class__(
+            **{**self.cfg.__dict__, "num_workers": num_workers})
+        self.algo_cfg = self.algo_cfg.replace(num_workers=num_workers)
+        # pull replicated state off the old mesh's devices before re-placing
+        old = jax.device_get(self.state)
+        self.state = init_dist_state(
+            old.params, old.model_state, self.optimizer, self.algo_cfg,
+            momentum_correction=self.cfg.momentum_correction)
+        self.state = self.state.replace(opt_state=old.opt_state)
+        self.step_fn = build_sparse_grad_step(
+            self._loss_fn, self.optimizer, self.algo_cfg, self.mesh,
+            compressor=self.cfg.compressor, axis_name=self.axis_name,
+            nsteps_update=self.cfg.nsteps_update,
+            grad_clip=self.cfg.grad_clip, warmup=self._warmup,
+            profile_norm=self._profile_norm,
+            momentum_correction=(self.cfg.momentum
+                                 if self.cfg.momentum_correction else 0.0))
 
     # ---- eval ---------------------------------------------------------
 
