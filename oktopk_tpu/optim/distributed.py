@@ -52,14 +52,21 @@ def flat_size(params) -> int:
 
 def init_dist_state(params, model_state, optimizer, cfg: OkTopkConfig,
                     dtype=jnp.float32,
-                    momentum_correction: bool = False) -> DistTrainState:
+                    momentum_correction: bool = False,
+                    opt_state: Any = None) -> DistTrainState:
+    """``momentum_correction`` must be truthy iff the step builder gets a
+    nonzero ``momentum_correction`` factor — the shard_map specs key off the
+    presence of ``local_momentum``. Pass ``opt_state`` to carry over existing
+    optimizer state (e.g. across an elastic resize) instead of allocating a
+    fresh one."""
     s = init_state(cfg, dtype)
     s = jax.tree.map(
         lambda x: jnp.broadcast_to(x, (cfg.num_workers,) + x.shape), s)
     mom = (jnp.zeros((cfg.num_workers, cfg.n), dtype)
            if momentum_correction else None)
     return DistTrainState(params=params, model_state=model_state,
-                          opt_state=optimizer.init(params),
+                          opt_state=(optimizer.init(params)
+                                     if opt_state is None else opt_state),
                           sparse_state=s, local_momentum=mom)
 
 

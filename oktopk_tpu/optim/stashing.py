@@ -1,0 +1,172 @@
+"""Weight stashing for pipeline-parallel training (PipeDream-style).
+
+Reference parity target: C9 — the pipeline weight-versioning optimizers
+(reference ``BERT/optimizer_with_stashing.py:19``
+``OptimizerWithStashing``, ``BERT/optimizer_with_stashing_and_aggregation.py:19``
+``OptimizerWithStashingAndAggregation``, ``BERT/optimizer.py:19``,
+``BERT/optimizer_with_aggregation.py``), validated there by the repo's only
+true unit tests (``BERT/tests/backprop/sgd_with_stashing.py:28-107``).
+
+Semantics (ported exactly, re-expressed functionally):
+
+- A ring buffer ("queue") of the last ``num_versions`` parameter versions,
+  initialised with ``num_versions`` clones of the initial params
+  (reference ``initialize_queue``, optimizer_with_stashing.py:63-68).
+- ``backward_params``: the OLDEST version in the queue (``queue[0]``) — the
+  weights a delayed backward pass must see so its gradient matches the
+  forward that produced the activations
+  (reference ``load_backward_params``, :115-117).
+- ``forward_params``: the NEWEST version (``queue[-1]``) — what new
+  minibatches enter the pipe with, and what the optimizer step updates
+  (reference ``load_forward_params`` :119-121 and ``_load_step_params``).
+- ``step``: divide grads by ``update_interval`` (reference
+  optimizer_with_stashing.py:144-146), apply the base optimizer update to
+  the newest version, bump the version counter, and push the result into the
+  ring (evicting the oldest; reference :152-157).
+
+With ``num_versions == 1`` the queue collapses and forward == backward ==
+latest: plain SGD (the reference test's ``test(1, [False, False])`` case).
+
+The aggregation variant (``AggregatingStash``) reproduces
+``OptimizerWithStashingAndAggregation``: ``num_versions`` is fixed at 2, and
+the version used for a given forward/backward pass is selected by
+``counter // update_interval`` (reference …_and_aggregation.py:117-147), with
+the version bump once per ``update_interval`` steps (:157-178).
+
+Everything is a pure pytree transform: state in, state out — no module
+mutation, no deque of cloned state_dicts. The queue is a stacked leading
+axis (``[V, ...]`` per leaf), so stash rotation is one ``concatenate`` per
+leaf and the whole thing jits.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+
+class StashState(NamedTuple):
+    """Ring buffer of parameter versions.
+
+    queue: pytree whose leaves have a leading axis of size ``num_versions``;
+      ``leaf[0]`` is the oldest version, ``leaf[-1]`` the newest.
+    latest_version: int32 scalar — number of optimizer steps taken
+      (reference ``Version`` counter).
+    """
+    queue: Any
+    latest_version: jnp.ndarray
+
+
+def stash_init(params, num_versions: int) -> StashState:
+    """Fill the queue with ``num_versions`` copies of ``params``
+    (reference ``initialize_queue``)."""
+    if num_versions < 1:
+        raise ValueError(f"num_versions must be >= 1, got {num_versions}")
+    queue = jax.tree.map(
+        lambda p: jnp.broadcast_to(p[None], (num_versions,) + p.shape), params)
+    return StashState(queue=queue, latest_version=jnp.int32(0))
+
+
+def backward_params(state: StashState):
+    """Oldest stashed version — weights for a delayed backward pass
+    (reference ``load_backward_params``)."""
+    return jax.tree.map(lambda q: q[0], state.queue)
+
+
+def forward_params(state: StashState):
+    """Newest version — weights for new forward passes and for the step
+    (reference ``load_forward_params`` / ``_load_step_params``)."""
+    return jax.tree.map(lambda q: q[-1], state.queue)
+
+
+def stash_step(state: StashState, grads, update_fn: Callable,
+               opt_state, update_interval: int = 1):
+    """One optimizer step with weight stashing.
+
+    Args:
+      state: current stash.
+      grads: gradient pytree (matching one version's structure).
+      update_fn: ``(params, grads, opt_state) -> (new_params, new_opt_state)``
+        — the base optimizer (e.g. ``sgd.sgd_update``; reference
+        ``base_optimizer.step``).
+      opt_state: base optimizer state.
+      update_interval: grads are pre-divided by this
+        (reference optimizer_with_stashing.py:144-146).
+
+    Returns: ``(new_stash_state, new_opt_state)``.
+    """
+    params = forward_params(state)
+    if update_interval != 1:
+        grads = jax.tree.map(lambda g: g / update_interval, grads)
+    new_params, new_opt_state = update_fn(params, grads, opt_state)
+    # push newest, evict oldest (deque.append with maxlen, reference :157)
+    queue = jax.tree.map(
+        lambda q, p: jnp.concatenate([q[1:], p[None]], axis=0),
+        state.queue, new_params)
+    return (StashState(queue=queue, latest_version=state.latest_version + 1),
+            new_opt_state)
+
+
+class AggregatingStashState(NamedTuple):
+    """State for the stashing-and-aggregation variant (2 fixed versions +
+    forward/backward counters; reference …_and_aggregation.py:36-55)."""
+    stash: StashState
+    forward_counter: jnp.ndarray
+    backward_counter: jnp.ndarray
+
+
+def aggregating_init(params, update_interval: int) -> AggregatingStashState:
+    # num_stages==1 degenerates to no stashing in the reference (:40-42);
+    # callers express that by update_interval == 1, which makes version
+    # selection always pick the newest.
+    del update_interval
+    return AggregatingStashState(
+        stash=stash_init(params, num_versions=2),
+        forward_counter=jnp.int32(0),
+        backward_counter=jnp.int32(0))
+
+
+def _select_version(state: AggregatingStashState, counter,
+                    update_interval: int):
+    """Reference …_and_aggregation.py:117-147: desired version is
+    ``max(counter // update_interval - 1, 0)``; the queue holds versions
+    ``[latest-1, latest]`` (or ``[0, 0]`` before any step)."""
+    desired = jnp.maximum(counter // update_interval - 1, 0)
+    latest = state.stash.latest_version
+    newest_tree = forward_params(state.stash)
+    oldest_tree = backward_params(state.stash)
+    take_newest = desired >= latest
+    return jax.tree.map(
+        lambda new, old: jnp.where(take_newest, new, old),
+        newest_tree, oldest_tree)
+
+
+def aggregating_forward_params(state: AggregatingStashState,
+                               update_interval: int):
+    """Params for the next forward pass; bumps the forward counter."""
+    params = _select_version(state, state.forward_counter, update_interval)
+    new_state = state._replace(forward_counter=state.forward_counter + 1)
+    return params, new_state
+
+
+def aggregating_backward_params(state: AggregatingStashState,
+                                update_interval: int):
+    """Params for the next backward pass; bumps the backward counter."""
+    params = _select_version(state, state.backward_counter, update_interval)
+    new_state = state._replace(backward_counter=state.backward_counter + 1)
+    return params, new_state
+
+
+def aggregating_step(state: AggregatingStashState, grads,
+                     update_fn: Callable, opt_state,
+                     update_interval: int):
+    """Step once per aggregation window: grads (already summed over the
+    window by the caller) are divided by ``update_interval``
+    (reference …_and_aggregation.py grad scaling), applied to the newest
+    version, and the ring rotates."""
+    new_stash, new_opt_state = stash_step(
+        state.stash, grads, update_fn, opt_state,
+        update_interval=update_interval)
+    return state._replace(stash=new_stash), new_opt_state

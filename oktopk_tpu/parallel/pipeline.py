@@ -24,11 +24,41 @@ classic GPipe schedule (S + M - 1 ticks, bubble included) is a ``lax.scan``.
 
 from __future__ import annotations
 
+from functools import partial
 from typing import Callable
 
 import jax
 import jax.numpy as jnp
 from jax import lax
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(1,))
+def _bcast_from_last(x, axis_name):
+    """Replicate the LAST stage's ``x`` to every rank.
+
+    Value: ``psum`` of a last-stage-masked buffer (only one rank
+    contributes). The custom VJP exists because under
+    ``shard_map(..., check_vma=False)`` the default ``psum`` transpose is
+    another ``psum``, which overcounts the (replicated) cotangent by the
+    axis size — every rank's copy of the SAME downstream loss would be
+    summed. The correct transpose of "broadcast from last" is "deliver the
+    cotangent to last, zero elsewhere"."""
+    P = lax.axis_size(axis_name)
+    s = lax.axis_index(axis_name)
+    return lax.psum(jnp.where(s == P - 1, x, jnp.zeros_like(x)), axis_name)
+
+
+def _bcast_from_last_fwd(x, axis_name):
+    return _bcast_from_last(x, axis_name), None
+
+
+def _bcast_from_last_bwd(axis_name, _res, ct):
+    P = lax.axis_size(axis_name)
+    s = lax.axis_index(axis_name)
+    return (jnp.where(s == P - 1, ct, jnp.zeros_like(ct)),)
+
+
+_bcast_from_last.defvjp(_bcast_from_last_fwd, _bcast_from_last_bwd)
 
 
 def gpipe_apply(stage_fn: Callable, stage_params, microbatches: jnp.ndarray,
@@ -84,11 +114,8 @@ def gpipe_apply(stage_fn: Callable, stage_params, microbatches: jnp.ndarray,
     (_, outputs), _ = lax.scan(tick, (zeros, outputs),
                                jnp.arange(M + P - 1))
     # every rank wrote only its own view; the real outputs live on the last
-    # stage — broadcast them with a masked psum
-    outputs = lax.psum(
-        jnp.where(stage == P - 1, outputs, jnp.zeros_like(outputs)),
-        axis_name)
-    return outputs
+    # stage — broadcast them (transpose-correct under jax.grad)
+    return _bcast_from_last(outputs, axis_name)
 
 
 def gpipe_loss(stage_fn: Callable, loss_fn: Callable, stage_params,
@@ -100,3 +127,95 @@ def gpipe_loss(stage_fn: Callable, loss_fn: Callable, stage_params,
                        num_microbatches, remat)
     losses = jax.vmap(loss_fn)(outs, targets)
     return jnp.mean(losses)
+
+
+def one_f_one_b(stage_fn: Callable, loss_fn: Callable, stage_params,
+                microbatches, targets, axis_name: str,
+                num_microbatches: int):
+    """1F1B-with-flushes schedule: ``(mean_loss, stage_grads)``.
+
+    Reference parity: ``run_training_loop_with_flushes`` with the 1F1B
+    ordering (BERT/runtime.py:740 — warmup forwards, steady-state alternate
+    fwd/bwd, drain backwards, step at the flush). Numerically identical to
+    ``jax.grad(gpipe_loss)`` (same weights for every microbatch — a flush —
+    so no weight stashing is needed; stashing lives in
+    ``optim/stashing.py`` for the no-flush PipeDream mode), but the
+    activation footprint is O(P) ring slots instead of GPipe's O(M):
+    each tick runs one forward slot and one backward slot, and a microbatch's
+    stage input is held only until its backward drains,
+    2·(P−1−s) ticks later.
+
+    Backward is explicit per-stage ``jax.vjp`` on the stashed stage INPUT —
+    i.e. within-stage activations are recomputed in backward, the XLA-native
+    form of the reference's recompute flag (BERT/runtime.py:546-558,666-667).
+
+    Schedule (tick t, stage s, P stages, M microbatches, T = M + 2P − 2):
+      forward of microbatch m at t = m + s;
+      backward of microbatch m at t = m + 2(P−1) − s
+      (last stage back-props a microbatch the same tick it forwards it).
+    Cotangents hop down one stage per tick via ``ppermute``.
+
+    Same restrictions as ``gpipe_apply``: call inside ``shard_map``;
+    activations share one shape/dtype; ``stage_fn(params, x, stage_index)``.
+    Returns each rank's OWN stage grads (sharded over ``axis_name``) and the
+    replicated mean loss.
+    """
+    P = lax.axis_size(axis_name)
+    stage = lax.axis_index(axis_name)
+    M = num_microbatches
+    W = 2 * P - 1  # max microbatches in flight at stage 0, inclusive
+
+    x_shape = microbatches.shape[1:]
+    dtype = microbatches.dtype
+    zeros_x = jnp.zeros(x_shape, dtype)
+    up = [(i, (i + 1) % P) for i in range(P)]
+    down = [(i, (i - 1) % P) for i in range(P)]
+
+    def tick(carry, t):
+        fwd_wire, bwd_wire, stash, gacc, lacc = carry
+
+        # -- forward slot: microbatch m_f = t - s
+        m_f = t - stage
+        do_f = (m_f >= 0) & (m_f < M)
+        inject = lax.dynamic_index_in_dim(
+            microbatches, jnp.clip(m_f, 0, M - 1), 0, keepdims=False)
+        x = jnp.where(stage == 0, inject, fwd_wire)
+        y = stage_fn(stage_params, x, stage)
+        slot_f = jnp.mod(m_f, W)
+        held = lax.dynamic_index_in_dim(stash, slot_f, 0, keepdims=False)
+        stash = lax.dynamic_update_index_in_dim(
+            stash, jnp.where(do_f, x, held), slot_f, 0)
+
+        # -- backward slot: microbatch m_b = t - 2(P-1) + s
+        m_b = t - 2 * (P - 1) + stage
+        do_b = (m_b >= 0) & (m_b < M)
+        slot_b = jnp.mod(m_b, W)
+        x_b = lax.dynamic_index_in_dim(stash, slot_b, 0, keepdims=False)
+        tgt = lax.dynamic_index_in_dim(
+            targets, jnp.clip(m_b, 0, M - 1), 0, keepdims=False)
+        y_b, vjp = jax.vjp(lambda p, xx: stage_fn(p, xx, stage),
+                           stage_params, x_b)
+        l, dldy = jax.value_and_grad(
+            lambda yy: loss_fn(yy, tgt))(y_b)
+        ct_out = jnp.where(stage == P - 1, dldy, bwd_wire)
+        gp, ct_in = vjp(ct_out)
+        gacc = jax.tree.map(
+            lambda a, g: a + jnp.where(do_b, g, jnp.zeros_like(g)), gacc, gp)
+        lacc = lacc + jnp.where(do_b & (stage == P - 1),
+                                l.astype(jnp.float32), 0.0)
+
+        # -- wires hop: activations up, cotangents down
+        fwd_wire = lax.ppermute(jnp.where(do_f, y, jnp.zeros_like(y)),
+                                axis_name, up)
+        bwd_wire = lax.ppermute(
+            jnp.where(do_b, ct_in, jnp.zeros_like(ct_in)), axis_name, down)
+        return (fwd_wire, bwd_wire, stash, gacc, lacc), None
+
+    init = (zeros_x, zeros_x, jnp.zeros((W,) + x_shape, dtype),
+            jax.tree.map(jnp.zeros_like, stage_params),
+            jnp.zeros((), jnp.float32))
+    (_, _, _, gacc, lacc), _ = lax.scan(tick, init,
+                                        jnp.arange(M + 2 * P - 2))
+    loss = lax.psum(lacc, axis_name) / M
+    grads = jax.tree.map(lambda g: g / M, gacc)
+    return loss, grads

@@ -55,17 +55,35 @@ def latest_checkpoint(ckpt_dir: str, prefix: str = "ckpt") -> Optional[str]:
     return os.path.join(ckpt_dir, max(steps)[1])
 
 
+def _merge_missing(template, loaded):
+    """Overlay ``loaded`` on ``template``, keeping template defaults for keys
+    the checkpoint predates (e.g. a DistTrainState field added after the
+    checkpoint was saved — strict flax restore would raise 'Missing field')."""
+    if isinstance(template, dict):
+        if not isinstance(loaded, dict):
+            return loaded
+        return {k: (_merge_missing(v, loaded[k]) if k in loaded else v)
+                for k, v in template.items()}
+    return loaded
+
+
 def restore_checkpoint(ckpt_dir_or_file: str, state_template: Any,
                        prefix: str = "ckpt") -> Tuple[Any, int]:
-    """Restore into the template's pytree structure; returns (state, step)."""
+    """Restore into the template's pytree structure; returns (state, step).
+
+    Fields present in the template but absent from the file keep the
+    template's (freshly initialised) values, so checkpoints saved before a
+    state field existed still resume."""
     path = ckpt_dir_or_file
     if os.path.isdir(path):
         path = latest_checkpoint(path, prefix)
         if path is None:
             raise FileNotFoundError(f"no checkpoints in {ckpt_dir_or_file}")
     with open(path, "rb") as f:
-        payload = flax.serialization.from_bytes(
-            {"step": 0, "state": jax.device_get(state_template)}, f.read())
+        raw = flax.serialization.msgpack_restore(f.read())
+    wrapped = {"step": 0, "state": jax.device_get(state_template)}
+    merged = _merge_missing(flax.serialization.to_state_dict(wrapped), raw)
+    payload = flax.serialization.from_state_dict(wrapped, merged)
     return payload["state"], int(payload["step"])
 
 

@@ -15,7 +15,9 @@ host and replicated by sharding spec.
 
 from __future__ import annotations
 
+import dataclasses
 import time
+import warnings
 from typing import Any, Dict, Iterable, Optional
 
 import jax
@@ -55,8 +57,8 @@ class Trainer:
         self.axis_name = axis_name
         num_workers = int(np.prod(
             [self.mesh.shape[a] for a in (axis_name,)]))
-        cfg = cfg if cfg.num_workers == num_workers else \
-            cfg.__class__(**{**cfg.__dict__, "num_workers": num_workers})
+        if cfg.num_workers != num_workers:
+            cfg = dataclasses.replace(cfg, num_workers=num_workers)
         self.cfg = cfg
 
         mk = dict(model_kwargs or {})
@@ -73,32 +75,46 @@ class Trainer:
         self.algo_cfg = (algo_cfg or OkTopkConfig()).replace(
             n=n, num_workers=num_workers, density=cfg.density)
 
+        # Momentum correction (DGC-style) folds momentum into the compressed
+        # gradient stream; it belongs to the SGD path only — Adam has its own
+        # moment accumulators, so folding on top would double-smooth.
         if cfg.dnn.startswith("bert"):
+            if cfg.momentum_correction:
+                warnings.warn(
+                    "momentum_correction is an SGD-path feature (reference "
+                    "VGG/distributed_optimizer.py:56,81-88); ignored for "
+                    "BERT/Adam workloads", stacklevel=2)
+            self._mc_factor = 0.0
             self.optimizer = bert_adam(
                 lr=cfg.lr, warmup=cfg.warmup_proportion,
                 t_total=cfg.total_steps or -1)
         else:
+            self._mc_factor = (cfg.momentum if cfg.momentum_correction
+                               else 0.0)
             # with momentum correction the momentum lives in the compressed
             # gradient stream, so the base SGD runs momentum-free
             self.optimizer = sgd(
                 cfg.lr,
-                momentum=0.0 if cfg.momentum_correction else cfg.momentum,
+                momentum=0.0 if self._mc_factor else cfg.momentum,
                 weight_decay=cfg.weight_decay, nesterov=cfg.nesterov)
 
         self._warmup = warmup
         self._profile_norm = profile_norm
         self.state = init_dist_state(
             params, self.model_state, self.optimizer, self.algo_cfg,
-            momentum_correction=cfg.momentum_correction)
-        self.step_fn = build_sparse_grad_step(
-            self._loss_fn, self.optimizer, self.algo_cfg, self.mesh,
-            compressor=cfg.compressor, axis_name=axis_name,
-            nsteps_update=cfg.nsteps_update, grad_clip=cfg.grad_clip,
-            warmup=warmup, profile_norm=profile_norm,
-            momentum_correction=(cfg.momentum
-                                 if cfg.momentum_correction else 0.0))
+            momentum_correction=bool(self._mc_factor))
+        self.step_fn = self._build_step()
         self._rng = jax.random.PRNGKey(cfg.seed + 1)
         self.metrics_history = []
+
+    def _build_step(self):
+        return build_sparse_grad_step(
+            self._loss_fn, self.optimizer, self.algo_cfg, self.mesh,
+            compressor=self.cfg.compressor, axis_name=self.axis_name,
+            nsteps_update=self.cfg.nsteps_update,
+            grad_clip=self.cfg.grad_clip, warmup=self._warmup,
+            profile_norm=self._profile_norm,
+            momentum_correction=self._mc_factor)
 
     # ---- workload-specific pieces -------------------------------------
 
@@ -214,23 +230,16 @@ class Trainer:
         """
         num_workers = int(new_mesh.shape[self.axis_name])
         self.mesh = new_mesh
-        self.cfg = self.cfg.__class__(
-            **{**self.cfg.__dict__, "num_workers": num_workers})
+        self.cfg = dataclasses.replace(self.cfg, num_workers=num_workers)
         self.algo_cfg = self.algo_cfg.replace(num_workers=num_workers)
-        # pull replicated state off the old mesh's devices before re-placing
-        old = jax.device_get(self.state)
+        # pull replicated state off the old mesh's devices before re-placing;
+        # params/model/opt state carry over, per-worker state re-initialises
+        old = jax.device_get(
+            (self.state.params, self.state.model_state, self.state.opt_state))
         self.state = init_dist_state(
-            old.params, old.model_state, self.optimizer, self.algo_cfg,
-            momentum_correction=self.cfg.momentum_correction)
-        self.state = self.state.replace(opt_state=old.opt_state)
-        self.step_fn = build_sparse_grad_step(
-            self._loss_fn, self.optimizer, self.algo_cfg, self.mesh,
-            compressor=self.cfg.compressor, axis_name=self.axis_name,
-            nsteps_update=self.cfg.nsteps_update,
-            grad_clip=self.cfg.grad_clip, warmup=self._warmup,
-            profile_norm=self._profile_norm,
-            momentum_correction=(self.cfg.momentum
-                                 if self.cfg.momentum_correction else 0.0))
+            old[0], old[1], self.optimizer, self.algo_cfg,
+            momentum_correction=bool(self._mc_factor), opt_state=old[2])
+        self.step_fn = self._build_step()
 
     # ---- eval ---------------------------------------------------------
 

@@ -67,3 +67,26 @@ class TestCheckpoint:
         save_checkpoint(str(tmp_path), trained.state, step=3)
         save_checkpoint(str(tmp_path), trained.state, step=10)
         assert latest_checkpoint(str(tmp_path)).endswith("ckpt-10.msgpack")
+
+    def test_restore_tolerates_missing_new_fields(self, trained, tmp_path):
+        """A checkpoint saved before a DistTrainState field existed must
+        still restore, keeping the template's fresh value for the new field
+        (regression: strict flax restore raised 'Missing field')."""
+        import flax.serialization
+        import jax
+
+        # simulate an old-format checkpoint: drop local_momentum (and one
+        # arbitrary nested dict key would be the same path)
+        host = jax.device_get(trained.state)
+        sd = flax.serialization.to_state_dict({"step": 3, "state": host})
+        sd["state"].pop("local_momentum", None)
+        path = str(tmp_path / "ckpt-3.msgpack")
+        with open(path, "wb") as f:
+            f.write(flax.serialization.msgpack_serialize(sd))
+
+        fresh = Trainer(trained.cfg, mesh=trained.mesh, warmup=False)
+        restored, step = restore_checkpoint(path, fresh.state)
+        assert step == 3
+        np.testing.assert_array_equal(
+            np.asarray(jax.tree.leaves(restored.params)[0]),
+            np.asarray(jax.tree.leaves(trained.state.params)[0]))

@@ -30,6 +30,32 @@ class TestMomentumCorrection:
         tr = Trainer(cfg, mesh=mesh4, warmup=False)
         assert tr.optimizer.momentum == 0.0
 
+    def test_zero_momentum_with_correction_flag(self, mesh4):
+        """momentum_correction=True with momentum=0.0 must not allocate a
+        momentum buffer the step specs don't expect (regression: spec
+        mismatch crash at the first train_step)."""
+        cfg = TrainConfig(dnn="mnistnet", dataset="mnist", batch_size=8,
+                          lr=0.05, momentum=0.0, momentum_correction=True,
+                          compressor="topkA", density=0.1)
+        tr = Trainer(cfg, mesh=mesh4, warmup=False)
+        assert tr.state.local_momentum is None
+        it = synthetic_iterator("mnistnet", 8, seed=2)
+        m = tr.train_step(next(it))
+        assert np.isfinite(float(m["loss"]))
+
+    def test_bert_ignores_momentum_correction(self, mesh4):
+        """Adam has its own moments — the DGC fold must not stack on top
+        (regression: double smoothing)."""
+        import warnings as w
+        cfg = TrainConfig(dnn="bert_tiny", dataset="wikipedia", batch_size=4,
+                          lr=1e-3, momentum=0.9, momentum_correction=True,
+                          compressor="topkA", density=0.1)
+        with w.catch_warnings(record=True) as caught:
+            w.simplefilter("always")
+            tr = Trainer(cfg, mesh=mesh4, warmup=False)
+        assert any("momentum_correction" in str(c.message) for c in caught)
+        assert tr.state.local_momentum is None
+
 
 class TestElasticResize:
     def test_resize_4_to_2(self, devices):

@@ -6,7 +6,7 @@ import numpy as np
 import pytest
 from jax.sharding import PartitionSpec as P
 
-from oktopk_tpu.parallel.pipeline import gpipe_apply, gpipe_loss
+from oktopk_tpu.parallel.pipeline import gpipe_apply, gpipe_loss, one_f_one_b
 from oktopk_tpu.parallel.ring_attention import ring_attention
 
 
@@ -112,8 +112,57 @@ class TestGPipe:
             check_vma=False))
         g = grad_fn(ws, x, y)
         assert g.shape == ws.shape
-        for i in range(4):
-            assert float(jnp.abs(g[i]).max()) > 0, f"stage {i} got no grad"
+
+        # exact check vs the sequential (no-pipeline) ground truth — guards
+        # the psum-transpose overcount fixed by _bcast_from_last
+        def seq_loss(ws_):
+            def per_mb(xm, ym):
+                h = xm
+                for i in range(4):
+                    h = jnp.tanh(h @ ws_[i])
+                return jnp.mean((h - ym) ** 2)
+            return jnp.mean(jax.vmap(per_mb)(x, y))
+
+        want = jax.grad(seq_loss)(ws)
+        np.testing.assert_allclose(np.asarray(g), np.asarray(want),
+                                   atol=1e-5)
+
+    @pytest.mark.parametrize("M", [4, 6])
+    def test_1f1b_matches_gpipe_grads(self, mesh4, rng, M):
+        """1F1B-with-flushes must be numerically identical to
+        jax.grad(gpipe_loss): same loss, same per-stage grads."""
+        mb, dim = 2, 4
+        x = jnp.asarray(rng.randn(M, mb, dim).astype(np.float32))
+        y = jnp.asarray(rng.randn(M, mb, dim).astype(np.float32))
+        ws = jnp.asarray(rng.randn(4, dim, dim).astype(np.float32) * 0.3)
+
+        def stage_fn(w, h, stage_idx):
+            return jnp.tanh(h @ w)
+
+        def sq(o, t):
+            return jnp.mean((o - t) ** 2)
+
+        def loss(ws_, x_, y_):
+            return gpipe_loss(stage_fn, sq, ws_[0], x_, y_, "data",
+                              num_microbatches=M)
+
+        want_loss, want_g = jax.jit(jax.shard_map(
+            jax.value_and_grad(loss), mesh=mesh4,
+            in_specs=(P("data"), P(), P()),
+            out_specs=(P(), P("data")), check_vma=False))(ws, x, y)
+
+        def f(ws_, x_, y_):
+            l, g = one_f_one_b(stage_fn, sq, ws_[0], x_, y_, "data",
+                               num_microbatches=M)
+            return l, g[None]
+
+        got_loss, got_g = jax.jit(jax.shard_map(
+            f, mesh=mesh4, in_specs=(P("data"), P(), P()),
+            out_specs=(P(), P("data")), check_vma=False))(ws, x, y)
+        np.testing.assert_allclose(float(got_loss), float(want_loss),
+                                   atol=1e-6)
+        np.testing.assert_allclose(np.asarray(got_g), np.asarray(want_g),
+                                   atol=1e-5)
 
     def test_remat_matches(self, mesh4, rng):
         M, mb, dim = 4, 2, 4
