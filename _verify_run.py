@@ -1,0 +1,5 @@
+import os
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+import jax; jax.config.update("jax_platforms", "cpu")
+import __graft_entry__ as ge
+ge.dryrun_multichip(8)

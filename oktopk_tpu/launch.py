@@ -1,0 +1,158 @@
+"""Multi-host launch / rendezvous layer.
+
+Reference C11 (SURVEY.md §2.1): ``BERT/launch.py:108-173`` spawns per-rank
+processes with ``--rank/--local_rank`` env, and ``init_distrib_slurm``
+(``BERT/bert/main_bert.py:159-203``) discovers rank/world size from
+``SLURM_*`` / ``LOCAL_RANK`` env vars, with MASTER_ADDR derived from
+``srun hostname`` (``BERT/bert/bert_oktopk.sh:23``).
+
+TPU-native shape: there is no torch.distributed rendezvous — each host runs
+the same driver, calls :func:`maybe_initialize` once, and
+``jax.distributed.initialize`` wires the hosts into one JAX runtime whose
+``jax.devices()`` spans every chip in the slice. After that, "rank" is just
+``jax.process_index()`` and model broadcast (reference
+``VGG/main_trainer.py:52-54``) is free: replicated shardings under pjit.
+
+Environment discovery order (first match wins):
+
+1. Explicit ``OKTOPK_COORDINATOR`` / ``OKTOPK_NUM_PROCS`` / ``OKTOPK_PROC_ID``.
+2. SLURM: ``SLURM_PROCID``/``SLURM_NTASKS``/``SLURM_STEP_NODELIST`` (the
+   coordinator is the first host of the nodelist — parsed natively, no
+   ``scontrol`` dependency).
+3. OpenMPI: ``OMPI_COMM_WORLD_RANK``/``OMPI_COMM_WORLD_SIZE`` (coordinator
+   must then come from ``OKTOPK_COORDINATOR``).
+4. Cloud TPU metadata: fall back to ``jax.distributed.initialize()`` with no
+   arguments, which autodetects on TPU pods.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+from dataclasses import dataclass
+from typing import List, Optional
+
+DEFAULT_PORT = 8476
+
+
+@dataclass(frozen=True)
+class ProcessEnv:
+    """One process's place in the job (reference's rank/world_size pair)."""
+
+    process_id: int
+    num_processes: int
+    coordinator: Optional[str]  # "host:port" or None (autodetect)
+    source: str                 # which discovery rule fired
+
+    @property
+    def is_coordinator(self) -> bool:
+        return self.process_id == 0
+
+
+def expand_nodelist(nodelist: str) -> List[str]:
+    """Expand a compact SLURM nodelist ("nid0[1234-1236,1240],login1") into
+    hostnames without shelling out to ``scontrol show hostnames`` (which the
+    reference's sbatch scripts rely on implicitly via ``srun hostname``,
+    ``BERT/bert/bert_oktopk.sh:23``)."""
+    hosts: List[str] = []
+    # split on commas not inside brackets
+    parts, depth, cur = [], 0, []
+    for ch in nodelist:
+        if ch == "[":
+            depth += 1
+        elif ch == "]":
+            depth -= 1
+        if ch == "," and depth == 0:
+            parts.append("".join(cur))
+            cur = []
+        else:
+            cur.append(ch)
+    if cur:
+        parts.append("".join(cur))
+
+    for part in parts:
+        m = re.fullmatch(r"([^\[\]]*)\[([^\]]+)\](.*)", part)
+        if not m:
+            if part:
+                hosts.append(part)
+            continue
+        prefix, body, suffix = m.groups()
+        for item in body.split(","):
+            if "-" in item:
+                lo, hi = item.split("-", 1)
+                width = len(lo)
+                for i in range(int(lo), int(hi) + 1):
+                    hosts.append(f"{prefix}{i:0{width}d}{suffix}")
+            else:
+                hosts.append(f"{prefix}{item}{suffix}")
+    return hosts
+
+
+def discover(env: Optional[dict] = None, port: int = DEFAULT_PORT) -> ProcessEnv:
+    """Discover this process's coordinates (reference ``init_distrib_slurm``,
+    BERT/bert/main_bert.py:159-203 — SLURM first, then explicit env)."""
+    e = os.environ if env is None else env
+
+    if "OKTOPK_NUM_PROCS" in e:
+        coord = e.get("OKTOPK_COORDINATOR")
+        if coord and ":" not in coord:
+            coord = f"{coord}:{port}"
+        nprocs = int(e["OKTOPK_NUM_PROCS"])
+        if nprocs > 1 and "OKTOPK_PROC_ID" not in e:
+            # Without a per-host id every host would claim process 0 and the
+            # rendezvous would hang waiting for the missing ranks.
+            raise RuntimeError(
+                "OKTOPK_NUM_PROCS > 1 but OKTOPK_PROC_ID is unset; export a "
+                "distinct OKTOPK_PROC_ID in [0, num_procs) on each host")
+        return ProcessEnv(
+            process_id=int(e.get("OKTOPK_PROC_ID", "0")),
+            num_processes=nprocs,
+            coordinator=coord, source="explicit")
+
+    if "SLURM_NTASKS" in e and "SLURM_PROCID" in e:
+        nodelist = e.get("SLURM_STEP_NODELIST", e.get("SLURM_NODELIST", ""))
+        hosts = expand_nodelist(nodelist) if nodelist else []
+        coord = f"{hosts[0]}:{port}" if hosts else None
+        return ProcessEnv(
+            process_id=int(e["SLURM_PROCID"]),
+            num_processes=int(e["SLURM_NTASKS"]),
+            coordinator=coord, source="slurm")
+
+    if "OMPI_COMM_WORLD_SIZE" in e:
+        coord = e.get("OKTOPK_COORDINATOR")
+        if coord and ":" not in coord:
+            coord = f"{coord}:{port}"
+        return ProcessEnv(
+            process_id=int(e["OMPI_COMM_WORLD_RANK"]),
+            num_processes=int(e["OMPI_COMM_WORLD_SIZE"]),
+            coordinator=coord, source="openmpi")
+
+    return ProcessEnv(process_id=0, num_processes=1, coordinator=None,
+                      source="single")
+
+
+_initialized = False
+
+
+def maybe_initialize(env: Optional[dict] = None, port: int = DEFAULT_PORT,
+                     force: bool = False) -> ProcessEnv:
+    """Initialize ``jax.distributed`` if this is a multi-process job.
+
+    Idempotent; single-process jobs (and CPU dry runs) skip initialization
+    entirely so tests and ``--fake-devices`` paths are unaffected.
+    """
+    global _initialized
+    penv = discover(env, port)
+    if penv.num_processes <= 1 and not force:
+        return penv
+    if _initialized:
+        return penv
+    import jax
+
+    kwargs = dict(num_processes=penv.num_processes,
+                  process_id=penv.process_id)
+    if penv.coordinator is not None:
+        kwargs["coordinator_address"] = penv.coordinator
+    jax.distributed.initialize(**kwargs)
+    _initialized = True
+    return penv

@@ -55,15 +55,38 @@ def latest_checkpoint(ckpt_dir: str, prefix: str = "ckpt") -> Optional[str]:
     return os.path.join(ckpt_dir, max(steps)[1])
 
 
-def _merge_missing(template, loaded):
+def _merge_missing(template, loaded, path="", defaulted=None, dropped=None):
     """Overlay ``loaded`` on ``template``, keeping template defaults for keys
     the checkpoint predates (e.g. a DistTrainState field added after the
-    checkpoint was saved — strict flax restore would raise 'Missing field')."""
+    checkpoint was saved — strict flax restore would raise 'Missing field').
+
+    A ``None`` in the checkpoint never replaces a non-``None`` template leaf
+    (e.g. a momentum buffer the saved run had disabled) — the template's
+    freshly-initialised value wins. ``defaulted``/``dropped`` collect the
+    key paths that kept template values / were ignored, for diagnostics."""
     if isinstance(template, dict):
         if not isinstance(loaded, dict):
             return loaded
-        return {k: (_merge_missing(v, loaded[k]) if k in loaded else v)
-                for k, v in template.items()}
+        if dropped is not None:
+            for k in loaded:
+                if k not in template:
+                    dropped.append(f"{path}{k}")
+        out = {}
+        for k, v in template.items():
+            if k in loaded:
+                lv = loaded[k]
+                if lv is None and v is not None:
+                    if defaulted is not None:
+                        defaulted.append(f"{path}{k}")
+                    out[k] = v
+                else:
+                    out[k] = _merge_missing(v, lv, f"{path}{k}/",
+                                            defaulted, dropped)
+            else:
+                if defaulted is not None:
+                    defaulted.append(f"{path}{k}")
+                out[k] = v
+        return out
     return loaded
 
 
@@ -82,7 +105,16 @@ def restore_checkpoint(ckpt_dir_or_file: str, state_template: Any,
     with open(path, "rb") as f:
         raw = flax.serialization.msgpack_restore(f.read())
     wrapped = {"step": 0, "state": jax.device_get(state_template)}
-    merged = _merge_missing(flax.serialization.to_state_dict(wrapped), raw)
+    defaulted, dropped = [], []
+    merged = _merge_missing(flax.serialization.to_state_dict(wrapped), raw,
+                            defaulted=defaulted, dropped=dropped)
+    if defaulted or dropped:
+        import logging
+        logging.getLogger("oktopk_tpu").warning(
+            "checkpoint %s does not fully match the current state: "
+            "%d field(s) kept fresh template values %s; %d checkpoint "
+            "field(s) ignored %s", path, len(defaulted), defaulted[:8],
+            len(dropped), dropped[:8])
     payload = flax.serialization.from_state_dict(wrapped, merged)
     return payload["state"], int(payload["step"])
 

@@ -50,6 +50,11 @@ def main(argv=None):
     import jax
     if args.fake_devices:
         jax.config.update("jax_platforms", "cpu")
+    else:
+        # Multi-host rendezvous (reference init_distrib_slurm,
+        # BERT/bert/main_bert.py:159-203) — no-op for single-process jobs.
+        from oktopk_tpu.launch import maybe_initialize
+        maybe_initialize()
 
     from oktopk_tpu.config import OkTopkConfig, TrainConfig
     from oktopk_tpu.data import make_dataset

@@ -64,6 +64,14 @@ def main(argv=None):
     import jax
     if args.fake_devices:
         jax.config.update("jax_platforms", "cpu")
+    else:
+        # Multi-host rendezvous (reference init_distrib_slurm,
+        # BERT/bert/main_bert.py:159-203) — no-op for single-process jobs.
+        from oktopk_tpu.launch import maybe_initialize
+        penv = maybe_initialize()
+        if penv.num_processes > 1:
+            print(f"[launch] process {penv.process_id}/{penv.num_processes}"
+                  f" via {penv.source}, coordinator={penv.coordinator}")
 
     from oktopk_tpu.config import OkTopkConfig, TrainConfig
     from oktopk_tpu.data import make_dataset

@@ -1,0 +1,23 @@
+#!/bin/bash -l
+# BERT-base Wikipedia pretraining with Ok-Topk on a TPU pod slice
+# (reference BERT/bert/bert_oktopk.sh: bs 8/worker, seq 128, 1024 minibatches,
+# density 0.01).
+#SBATCH --nodes=8
+#SBATCH --ntasks=8
+#SBATCH --ntasks-per-node=1
+#SBATCH --time=01:00:00
+#SBATCH --output=bert_oktopk_density1.txt
+
+set -eu
+cd "$(dirname "$0")/.."
+
+srun python -m oktopk_tpu.train.main_bert \
+    --model bert_base \
+    --max-seq-length 128 \
+    --batch-size 8 \
+    --data-dir ./bert_data \
+    --ckpt-dir ./checkpoints_oktopk \
+    --num-minibatches 1024 \
+    --density 0.01 \
+    --compressor oktopk \
+    --gradient-accumulation-steps 1

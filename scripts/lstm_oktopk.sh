@@ -1,0 +1,22 @@
+#!/bin/bash -l
+# DeepSpeech/AN4 Ok-Topk on a TPU pod slice (reference LSTM/lstm_oktopk.sh).
+#SBATCH --nodes=8
+#SBATCH --ntasks=8
+#SBATCH --ntasks-per-node=1
+#SBATCH --time=01:00:00
+#SBATCH --output=lstm_oktopk_density2.txt
+
+set -eu
+cd "$(dirname "$0")/.."
+
+dnn="${dnn:-lstman4}"
+density="${density:-0.02}"
+compressor="${compressor:-oktopk}"
+source scripts/exp_configs/$dnn.conf
+sigmascale=2.5
+
+srun python -m oktopk_tpu.train.main_trainer \
+    --dnn "$dnn" --dataset "$dataset" --max-epochs "$max_epochs" \
+    --batch-size "$batch_size" --lr "$lr" --data-dir "$data_dir" \
+    --nsteps-update "$nstepsupdate" --sigma-scale "$sigmascale" \
+    --density "$density" --compressor "$compressor" --grad-clip 400

@@ -1,0 +1,10 @@
+#!/bin/bash
+# Submit the full algorithm sweep for one workload (reference
+# VGG/sbatch_vgg_jobs.sh:1-7 submits all six algorithms on the same model).
+# Usage: scripts/sbatch_jobs.sh [vgg16_oktopk.sh]
+set -eu
+job="${1:-vgg16_oktopk.sh}"
+cd "$(dirname "$0")"
+for compressor in oktopk topkA gaussiank gtopk topkDSA dense; do
+    compressor=$compressor sbatch "$job"
+done

@@ -1,0 +1,24 @@
+#!/bin/bash -l
+# VGG-16/CIFAR-10 Ok-Topk on a TPU pod slice (reference VGG/vgg16_oktopk.sh).
+# One task per TPU host; jax.distributed wires the hosts into a single mesh
+# (oktopk_tpu/launch.py discovers rank/coordinator from SLURM_* env).
+#SBATCH --nodes=4
+#SBATCH --ntasks=4
+#SBATCH --ntasks-per-node=1
+#SBATCH --time=01:20:00
+#SBATCH --output=vgg_oktopk_density2.txt
+
+set -eu
+cd "$(dirname "$0")/.."
+
+dnn="${dnn:-vgg16}"
+density="${density:-0.02}"
+compressor="${compressor:-oktopk}"
+source scripts/exp_configs/$dnn.conf
+sigmascale=2.5
+
+srun python -m oktopk_tpu.train.main_trainer \
+    --dnn "$dnn" --dataset "$dataset" --max-epochs "$max_epochs" \
+    --batch-size "$batch_size" --lr "$lr" --data-dir "$data_dir" \
+    --nsteps-update "$nstepsupdate" --sigma-scale "$sigmascale" \
+    --density "$density" --compressor "$compressor"

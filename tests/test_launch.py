@@ -1,0 +1,88 @@
+"""Launch-layer tests (reference C11: BERT/launch.py + init_distrib_slurm,
+BERT/bert/main_bert.py:159-203)."""
+
+from oktopk_tpu.launch import DEFAULT_PORT, discover, expand_nodelist
+
+
+class TestExpandNodelist:
+    def test_plain_host(self):
+        assert expand_nodelist("nid01234") == ["nid01234"]
+
+    def test_comma_list(self):
+        assert expand_nodelist("a,b,c") == ["a", "b", "c"]
+
+    def test_bracket_range(self):
+        assert expand_nodelist("nid0[1234-1236]") == [
+            "nid01234", "nid01235", "nid01236"]
+
+    def test_bracket_mixed(self):
+        assert expand_nodelist("nid0[1234-1235,1240]") == [
+            "nid01234", "nid01235", "nid01240"]
+
+    def test_zero_padding_preserved(self):
+        assert expand_nodelist("n[08-10]") == ["n08", "n09", "n10"]
+
+    def test_mixed_list_and_brackets(self):
+        assert expand_nodelist("login1,nid0[0001-0002]") == [
+            "login1", "nid00001", "nid00002"]
+
+    def test_suffix_after_bracket(self):
+        assert expand_nodelist("n[1-2]-ib") == ["n1-ib", "n2-ib"]
+
+
+class TestDiscover:
+    def test_single_process_default(self):
+        penv = discover(env={})
+        assert penv.num_processes == 1
+        assert penv.process_id == 0
+        assert penv.coordinator is None
+        assert penv.source == "single"
+        assert penv.is_coordinator
+
+    def test_slurm(self):
+        env = {"SLURM_PROCID": "3", "SLURM_NTASKS": "16",
+               "SLURM_NODELIST": "nid0[1234-1249]"}
+        penv = discover(env=env)
+        assert penv.process_id == 3
+        assert penv.num_processes == 16
+        assert penv.coordinator == f"nid01234:{DEFAULT_PORT}"
+        assert penv.source == "slurm"
+        assert not penv.is_coordinator
+
+    def test_slurm_step_nodelist_preferred(self):
+        env = {"SLURM_PROCID": "0", "SLURM_NTASKS": "2",
+               "SLURM_NODELIST": "wrong[1-9]",
+               "SLURM_STEP_NODELIST": "right1,right2"}
+        assert discover(env=env).coordinator == f"right1:{DEFAULT_PORT}"
+
+    def test_explicit_overrides_slurm(self):
+        env = {"OKTOPK_NUM_PROCS": "4", "OKTOPK_PROC_ID": "1",
+               "OKTOPK_COORDINATOR": "tpu-host-0",
+               "SLURM_PROCID": "9", "SLURM_NTASKS": "99"}
+        penv = discover(env=env)
+        assert penv.num_processes == 4
+        assert penv.process_id == 1
+        assert penv.coordinator == f"tpu-host-0:{DEFAULT_PORT}"
+        assert penv.source == "explicit"
+
+    def test_explicit_coordinator_with_port(self):
+        env = {"OKTOPK_NUM_PROCS": "2", "OKTOPK_PROC_ID": "0",
+               "OKTOPK_COORDINATOR": "host:1234"}
+        assert discover(env=env).coordinator == "host:1234"
+
+    def test_openmpi(self):
+        env = {"OMPI_COMM_WORLD_RANK": "2", "OMPI_COMM_WORLD_SIZE": "8",
+               "OKTOPK_COORDINATOR": "head"}
+        penv = discover(env=env)
+        assert penv.process_id == 2
+        assert penv.num_processes == 8
+        assert penv.coordinator == f"head:{DEFAULT_PORT}"
+        assert penv.source == "openmpi"
+
+
+def test_maybe_initialize_single_process_noop():
+    from oktopk_tpu import launch
+
+    penv = launch.maybe_initialize(env={})
+    assert penv.num_processes == 1
+    assert not launch._initialized
