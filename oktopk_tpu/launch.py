@@ -122,6 +122,11 @@ def discover(env: Optional[dict] = None, port: int = DEFAULT_PORT) -> ProcessEnv
         coord = e.get("OKTOPK_COORDINATOR")
         if coord and ":" not in coord:
             coord = f"{coord}:{port}"
+        if coord is None and int(e["OMPI_COMM_WORLD_SIZE"]) > 1:
+            raise RuntimeError(
+                "OpenMPI launch detected but OKTOPK_COORDINATOR is unset; "
+                "export OKTOPK_COORDINATOR=<rank-0 host> on every rank "
+                "(jax.distributed cannot autodetect an OpenMPI rendezvous)")
         return ProcessEnv(
             process_id=int(e["OMPI_COMM_WORLD_RANK"]),
             num_processes=int(e["OMPI_COMM_WORLD_SIZE"]),

@@ -96,7 +96,9 @@ def main(argv=None):
                       log_every=args.log_every, logger=logger)
     logger.info("done: loss %.4f comm volume/step %.0f elems",
                 float(m["loss"]), float(m["comm_volume"]))
-    if args.ckpt_dir:
+    # rank-0 writes only (reference saves via rank_in_stage==0,
+    # BERT/bert/main_bert.py:207-219): shared-filesystem safety.
+    if args.ckpt_dir and jax.process_index() == 0:
         from oktopk_tpu.train.checkpoint import save_checkpoint
         save_checkpoint(args.ckpt_dir, trainer.state, args.num_minibatches)
     return 0

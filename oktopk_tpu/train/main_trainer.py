@@ -45,6 +45,14 @@ def parse_args(argv=None):
                    help="virtual CPU devices for dry runs")
     p.add_argument("--log-every", type=int, default=50)
     p.add_argument("--logdir", default="./logs")
+    p.add_argument("--trace-at", type=int, default=0,
+                   help="capture a jax.profiler trace starting at this "
+                        "step (0 = off); view with xprof/tensorboard")
+    p.add_argument("--trace-steps", type=int, default=3)
+    p.add_argument("--phase-timers", action="store_true",
+                   help="log data-wait vs device-step phase table every "
+                        "--log-every steps (reference _print_profiling, "
+                        "VGG/allreducer.py:379-439)")
     p.add_argument("--ckpt-dir", default=None)
     p.add_argument("--ckpt-every", type=int, default=0,
                    help="checkpoint every N iterations (0 = off)")
@@ -87,8 +95,13 @@ def main(argv=None):
         grad_clip=args.grad_clip, seed=args.seed,
         num_workers=len(jax.devices()))
     slug = cfg.experiment_slug()
-    logger = get_logger("oktopk_tpu",
-                        os.path.join(args.logdir, slug, "rank0.log"))
+    # Observability and checkpoints are rank-0 work (the reference gates its
+    # writer/checkpointer the same way, VGG/dl_trainer.py:614-616) — on a
+    # shared filesystem every process writing the same paths corrupts them.
+    is_rank0 = jax.process_index() == 0
+    logger = get_logger(
+        "oktopk_tpu",
+        os.path.join(args.logdir, slug, f"rank{jax.process_index()}.log"))
     logger.info("experiment %s on %d devices", slug, len(jax.devices()))
 
     algo_cfg = OkTopkConfig(sigma_scale=args.sigma_scale)
@@ -97,6 +110,7 @@ def main(argv=None):
 
     trainer = Trainer(cfg, algo_cfg=algo_cfg)
 
+    start_iter = 0
     if args.resume:
         from oktopk_tpu.train.checkpoint import restore_checkpoint
         trainer.state, start_iter = restore_checkpoint(
@@ -116,17 +130,51 @@ def main(argv=None):
     total = args.max_iters or args.max_epochs * iters_per_epoch
     logger.info("training %d iterations (%d/epoch)", total, iters_per_epoch)
 
-    done = 0
-    while done < total:
-        chunk = min(total - done, iters_per_epoch)
-        m = trainer.train(data_iter, chunk, log_every=args.log_every,
-                          logger=logger)
-        done += chunk
-        logger.info("epoch done @ iter %d: loss %.4f vol/step %.0f", done,
-                    float(m["loss"]), float(m["comm_volume"]))
-        if args.ckpt_dir and args.ckpt_every and done % args.ckpt_every == 0:
-            from oktopk_tpu.train.checkpoint import save_checkpoint
-            save_checkpoint(args.ckpt_dir, trainer.state, done)
+    from oktopk_tpu.utils.profiling import (MetricWriter, PhaseTimers,
+                                            TraceWindow, device_memory_stats)
+    rundir = os.path.join(args.logdir, slug)
+    writer = MetricWriter(rundir) if is_rank0 else None
+    timers = PhaseTimers(every=args.log_every) if args.phase_timers else None
+    trace = (TraceWindow(os.path.join(rundir, "trace"), args.trace_at,
+                         args.trace_steps) if args.trace_at and is_rank0
+             else None)
+
+    done = start_iter
+    try:
+        while done < total:
+            chunk = min(total - done, iters_per_epoch)
+            m = trainer.train(data_iter, chunk, log_every=args.log_every,
+                              logger=logger, metric_writer=writer,
+                              timers=timers, trace=trace, start_step=done)
+            done += chunk
+            from oktopk_tpu import settings
+            if settings.PROFILING_GRAD and is_rank0:
+                # gradient-stream snapshot (reference dumps raw .npy grads at
+                # fixed iterations, VGG/allreducer.py:608-623): the residual
+                # IS the un-transmitted gradient mass plus thresholds/counts.
+                import numpy as _np
+                ss = jax.device_get(trainer.state.sparse_state)
+                dump_dir = os.path.join(rundir, "grad_dumps")
+                os.makedirs(dump_dir, exist_ok=True)
+                _np.savez_compressed(
+                    os.path.join(dump_dir, f"iter_{done}.npz"),
+                    residual=_np.asarray(ss.residual),
+                    local_threshold=_np.asarray(ss.local_threshold),
+                    global_threshold=_np.asarray(ss.global_threshold))
+            mem = device_memory_stats()
+            logger.info(
+                "epoch done @ iter %d: loss %.4f vol/step %.0f hbm %.0fMiB",
+                done, float(m["loss"]), float(m["comm_volume"]),
+                mem.get("bytes_in_use", 0) / 2**20)
+            if (is_rank0 and args.ckpt_dir and args.ckpt_every
+                    and done % args.ckpt_every == 0):
+                from oktopk_tpu.train.checkpoint import save_checkpoint
+                save_checkpoint(args.ckpt_dir, trainer.state, done)
+    finally:
+        if writer is not None:
+            writer.close()
+        if trace is not None:
+            trace.close()
     return 0
 
 

@@ -195,21 +195,56 @@ class Trainer:
         return metrics
 
     def train(self, data_iter: Iterable, num_iters: int,
-              log_every: int = 50, logger=None):
+              log_every: int = 50, logger=None, metric_writer=None,
+              timers=None, trace=None, start_step: int = 0):
         """Run ``num_iters`` steps (reference trainer.train(nsteps),
-        VGG/dl_trainer.py:597). Returns the last metrics dict."""
+        VGG/dl_trainer.py:597). Returns the last metrics dict.
+
+        Optional observability hooks (SURVEY.md §5.1): ``metric_writer``
+        (utils.profiling.MetricWriter) records per-step scalars,
+        ``timers`` (PhaseTimers) splits data-wait vs device-step time,
+        ``trace`` (TraceWindow) captures a bounded jax.profiler trace.
+        """
         metrics = {}
+        pending = []  # (step, device-metrics) — flushed on the log cadence
+        # so the writer never forces a per-step device sync
+
+        def flush_pending():
+            for s, dm in pending:
+                metric_writer.write(s, {
+                    k: float(np.asarray(v).mean()) for k, v in dm.items()})
+            pending.clear()
+
         t0 = time.time()
         for i in range(num_iters):
-            batch = next(data_iter)
-            metrics = self.train_step(batch)
-            if logger and (i + 1) % log_every == 0:
-                dt = (time.time() - t0) / log_every
-                logger.info(
-                    "iter %d loss %.4f vol %.0f %.3fs/it", i + 1,
-                    float(metrics["loss"]), float(metrics["comm_volume"]),
-                    dt)
-                t0 = time.time()
+            step = start_step + i + 1
+            if trace is not None:
+                trace.on_step(step)
+            if timers is not None:
+                with timers.phase("data"):
+                    batch = next(data_iter)
+                with timers.phase("step"):
+                    metrics = self.train_step(batch)
+                    jax.block_until_ready(metrics["loss"])
+            else:
+                batch = next(data_iter)
+                metrics = self.train_step(batch)
+            if metric_writer is not None:
+                pending.append((step, metrics))
+            if (i + 1) % log_every == 0:
+                if metric_writer is not None:
+                    flush_pending()
+                if logger:
+                    dt = (time.time() - t0) / log_every
+                    logger.info(
+                        "iter %d loss %.4f vol %.0f %.3fs/it", i + 1,
+                        float(metrics["loss"]),
+                        float(metrics["comm_volume"]), dt)
+                    t0 = time.time()
+            if timers is not None and logger is not None:
+                timers.maybe_log(step, logger)
+        if metric_writer is not None:
+            flush_pending()
         self.metrics_history.append(
             {k: float(np.asarray(v).mean()) for k, v in metrics.items()})
         return metrics

@@ -1,0 +1,185 @@
+"""Profiling / tracing subsystem (SURVEY.md §5.1).
+
+Reference shape: per-phase wall-clock timer dicts in the allreducer
+(``_merge/_compression/_allreduce/_demerge/_d2h/_h2d_timers``,
+VGG/allreducer.py:256-262) dumped every 50 steps as a per-layer-group table
+by ``_print_profiling`` (VGG/allreducer.py:379-439), plus TensorBoard scalars
+(VGG/dl_trainer.py:611-613) and GPU/CPU memory logging
+(VGG/dl_trainer.py:697-699).
+
+TPU-native reality: the compression/collective phases fuse into ONE XLA
+program, so intra-step phase timing moves to (a) coarse host-side phases
+(data wait / step / eval), (b) analytic counters carried in SparseState
+(selection counts, comm volume), and (c) ``jax.profiler`` traces for
+op-level attribution in xprof. This module provides all three:
+
+- :class:`PhaseTimers` — host-side phase accounting with the reference's
+  every-N-steps table dump;
+- :class:`MetricWriter` — per-step scalar log (CSV; the reference's
+  tensorboardX writer equivalent, gated to stay dependency-free);
+- :func:`trace_window` / :class:`TraceWindow` — a bounded
+  ``jax.profiler`` trace around chosen steps;
+- :func:`device_memory_stats` — HBM in-use/limit (the
+  ``torch.cuda.memory_allocated`` analogue).
+"""
+
+from __future__ import annotations
+
+import csv
+import os
+import time
+from collections import defaultdict
+from contextlib import contextmanager
+from typing import Dict, Iterable, Optional
+
+
+class PhaseTimers:
+    """Rolling per-phase wall-clock accounting.
+
+    ``with timers.phase("step"): ...`` accumulates a sample; ``table()``
+    renders the reference-style mean/total dump (VGG/allreducer.py:379-439),
+    and ``maybe_log(step, logger)`` prints it every ``every`` steps then
+    resets, like the reference's 50-step cadence.
+    """
+
+    def __init__(self, every: int = 50):
+        self.every = every
+        self._samples: Dict[str, list] = defaultdict(list)
+
+    @contextmanager
+    def phase(self, name: str):
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self._samples[name].append(time.perf_counter() - t0)
+
+    def add(self, name: str, seconds: float) -> None:
+        self._samples[name].append(seconds)
+
+    def table(self) -> str:
+        rows = [f"{'phase':<14}{'mean_ms':>10}{'total_s':>10}{'count':>8}"]
+        for name in sorted(self._samples):
+            s = self._samples[name]
+            mean = sum(s) / len(s)
+            rows.append(
+                f"{name:<14}{mean * 1e3:>10.2f}{sum(s):>10.3f}{len(s):>8d}")
+        return "\n".join(rows)
+
+    def reset(self) -> None:
+        self._samples.clear()
+
+    def maybe_log(self, step: int, logger) -> bool:
+        if self.every and step % self.every == 0 and self._samples:
+            logger.info("phase timing @ step %d\n%s", step, self.table())
+            self.reset()
+            return True
+        return False
+
+
+class MetricWriter:
+    """Append-only per-step scalar log: ``<logdir>/scalars.csv``.
+
+    Stands in for the reference's rank-0 tensorboardX writer
+    (VGG/main_trainer.py:170-172, VGG/dl_trainer.py:611-613) without the
+    dependency; the CSV loads straight into pandas for the same plots.
+    """
+
+    def __init__(self, logdir: str, filename: str = "scalars.csv"):
+        os.makedirs(logdir, exist_ok=True)
+        self.path = os.path.join(logdir, filename)
+        self._file = open(self.path, "a", newline="")
+        self._writer = csv.writer(self._file)
+        self._header_written = os.path.getsize(self.path) > 0
+        self._fields: Optional[list] = None
+
+    def write(self, step: int, scalars: Dict[str, float]) -> None:
+        if self._fields is None:
+            self._fields = sorted(scalars)
+            if not self._header_written:
+                self._writer.writerow(["step"] + self._fields)
+        row = [step] + [format(float(scalars.get(k, float("nan"))), ".8g")
+                        for k in self._fields]
+        self._writer.writerow(row)
+        self._file.flush()
+
+    def close(self) -> None:
+        self._file.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+class TraceWindow:
+    """Start a ``jax.profiler`` trace at ``start_step`` and stop it
+    ``num_steps`` later — a bounded xprof capture (the TPU replacement for
+    the reference's flag-gated deep profiling, VGG/settings.py:20-26)."""
+
+    def __init__(self, logdir: str, start_step: int, num_steps: int = 3):
+        self.logdir = logdir
+        self.start_step = start_step
+        self.stop_step = start_step + num_steps
+        self._active = False
+
+    def on_step(self, step: int) -> None:
+        import jax
+
+        if step == self.start_step and not self._active:
+            os.makedirs(self.logdir, exist_ok=True)
+            jax.profiler.start_trace(self.logdir)
+            self._active = True
+        elif step >= self.stop_step and self._active:
+            jax.profiler.stop_trace()
+            self._active = False
+
+    def close(self) -> None:
+        if self._active:
+            import jax
+
+            jax.profiler.stop_trace()
+            self._active = False
+
+
+@contextmanager
+def trace_window(logdir: str):
+    """Trace everything inside the block (convenience for benchmarks)."""
+    import jax
+
+    os.makedirs(logdir, exist_ok=True)
+    jax.profiler.start_trace(logdir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+def device_memory_stats(device=None) -> Dict[str, float]:
+    """HBM usage for one device (reference logs
+    ``torch.cuda.memory_allocated``/psutil RSS, VGG/dl_trainer.py:697-699).
+    Returns {} on backends without memory_stats (CPU)."""
+    import jax
+
+    dev = device or jax.local_devices()[0]
+    stats = getattr(dev, "memory_stats", lambda: None)()
+    if not stats:
+        return {}
+    out = {}
+    for key in ("bytes_in_use", "peak_bytes_in_use", "bytes_limit"):
+        if key in stats:
+            out[key] = float(stats[key])
+    return out
+
+
+def host_memory_stats() -> Dict[str, float]:
+    """Host RSS via /proc (psutil-free)."""
+    try:
+        with open("/proc/self/status") as f:
+            for line in f:
+                if line.startswith("VmRSS:"):
+                    return {"host_rss_bytes": float(line.split()[1]) * 1024}
+    except OSError:
+        pass
+    return {}

@@ -9,7 +9,9 @@
 #SBATCH --output=bert_oktopk_density1.txt
 
 set -eu
-cd "$(dirname "$0")/.."
+# sbatch copies the script to the slurm spool dir, so $0 is
+# useless there — prefer the submit dir (set by sbatch).
+cd "${SLURM_SUBMIT_DIR:-$(dirname "$0")/..}"
 
 srun python -m oktopk_tpu.train.main_bert \
     --model bert_base \

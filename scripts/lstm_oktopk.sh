@@ -7,7 +7,9 @@
 #SBATCH --output=lstm_oktopk_density2.txt
 
 set -eu
-cd "$(dirname "$0")/.."
+# sbatch copies the script to the slurm spool dir, so $0 is
+# useless there — prefer the submit dir (set by sbatch).
+cd "${SLURM_SUBMIT_DIR:-$(dirname "$0")/..}"
 
 dnn="${dnn:-lstman4}"
 density="${density:-0.02}"

@@ -4,7 +4,8 @@
 # Usage: scripts/sbatch_jobs.sh [vgg16_oktopk.sh]
 set -eu
 job="${1:-vgg16_oktopk.sh}"
-cd "$(dirname "$0")"
+# submit from the repo root so SLURM_SUBMIT_DIR (the job's cwd) is the repo
+cd "$(dirname "$0")/.."
 for compressor in oktopk topkA gaussiank gtopk topkDSA dense; do
-    compressor=$compressor sbatch "$job"
+    compressor=$compressor sbatch "scripts/$job"
 done

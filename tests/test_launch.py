@@ -79,6 +79,20 @@ class TestDiscover:
         assert penv.coordinator == f"head:{DEFAULT_PORT}"
         assert penv.source == "openmpi"
 
+    def test_openmpi_missing_coordinator_raises(self):
+        import pytest
+
+        env = {"OMPI_COMM_WORLD_RANK": "0", "OMPI_COMM_WORLD_SIZE": "8"}
+        with pytest.raises(RuntimeError, match="OKTOPK_COORDINATOR"):
+            discover(env=env)
+
+    def test_explicit_missing_proc_id_raises(self):
+        import pytest
+
+        env = {"OKTOPK_NUM_PROCS": "4", "OKTOPK_COORDINATOR": "h"}
+        with pytest.raises(RuntimeError, match="OKTOPK_PROC_ID"):
+            discover(env=env)
+
 
 def test_maybe_initialize_single_process_noop():
     from oktopk_tpu import launch
