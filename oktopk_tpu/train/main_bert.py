@@ -38,6 +38,9 @@ def parse_args(argv=None):
     p.add_argument("--log-every", type=int, default=10)
     p.add_argument("--ckpt-dir", default=None)
     p.add_argument("--resume", default=None)
+    p.add_argument("--handle-preemption", action="store_true",
+                   help="graceful preempt: checkpoint + requeue on SIGUSR1 "
+                        "(reference BERT/bert/main_bert.py:73-203)")
     return p.parse_args(argv)
 
 
@@ -80,10 +83,21 @@ def main(argv=None):
         local_adapt_scale=1.025, global_adapt_scale=1.036)
 
     trainer = Trainer(cfg, algo_cfg=algo_cfg)
+    preempt = None
+    if args.handle_preemption:
+        from oktopk_tpu.train.preemption import PreemptionHandler
+        preempt = PreemptionHandler()
+    start = 0
     if args.resume:
         from oktopk_tpu.train.checkpoint import restore_checkpoint
         trainer.state, start = restore_checkpoint(args.resume, trainer.state)
         logger.info("resumed at step %d", start)
+    elif args.handle_preemption:
+        from oktopk_tpu.train.preemption import load_interrupted_state
+        parked = load_interrupted_state(trainer.state)
+        if parked is not None:
+            trainer.state, start = parked
+            logger.info("resumed interrupted state at step %d", start)
 
     global_bs = (args.batch_size * num_workers
                  * args.gradient_accumulation_steps)
@@ -92,10 +106,21 @@ def main(argv=None):
     if meta.get("synthetic"):
         logger.warning("Wikipedia shards not found: synthetic MLM/NSP data")
 
-    m = trainer.train(data_iter, args.num_minibatches,
-                      log_every=args.log_every, logger=logger)
-    logger.info("done: loss %.4f comm volume/step %.0f elems",
-                float(m["loss"]), float(m["comm_volume"]))
+    remaining = max(0, args.num_minibatches - start)
+    m = trainer.train(data_iter, remaining,
+                      log_every=args.log_every, logger=logger,
+                      start_step=start,
+                      should_stop=(preempt.should_stop if preempt else None))
+    if preempt is not None:
+        from oktopk_tpu.train.preemption import epilogue
+        rc = epilogue(trainer.state, trainer.last_step, preempt, logger,
+                      rank=jax.process_index(),
+                      completed=trainer.last_step >= args.num_minibatches)
+        if rc:
+            return rc
+    if m:
+        logger.info("done: loss %.4f comm volume/step %.0f elems",
+                    float(m["loss"]), float(m["comm_volume"]))
     # rank-0 writes only (reference saves via rank_in_stage==0,
     # BERT/bert/main_bert.py:207-219): shared-filesystem safety.
     if args.ckpt_dir and jax.process_index() == 0:

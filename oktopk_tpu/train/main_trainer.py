@@ -58,6 +58,11 @@ def parse_args(argv=None):
                    help="checkpoint every N iterations (0 = off)")
     p.add_argument("--resume", default=None,
                    help="checkpoint directory to resume from")
+    p.add_argument("--handle-preemption", action="store_true",
+                   help="install SIGTERM/SIGUSR1/SIGUSR2 handlers: on "
+                        "preemption, checkpoint to ~/.interrupted_states "
+                        "and (SIGUSR1) scontrol requeue — reference "
+                        "BERT/bert/main_bert.py:73-203")
     return p.parse_args(argv)
 
 
@@ -110,12 +115,23 @@ def main(argv=None):
 
     trainer = Trainer(cfg, algo_cfg=algo_cfg)
 
+    preempt = None
+    if args.handle_preemption:
+        from oktopk_tpu.train.preemption import (PreemptionHandler,
+                                                 load_interrupted_state)
+        preempt = PreemptionHandler()
+
     start_iter = 0
     if args.resume:
         from oktopk_tpu.train.checkpoint import restore_checkpoint
         trainer.state, start_iter = restore_checkpoint(
             args.resume, trainer.state)
         logger.info("resumed from %s at iter %d", args.resume, start_iter)
+    elif args.handle_preemption:
+        parked = load_interrupted_state(trainer.state)
+        if parked is not None:
+            trainer.state, start_iter = parked
+            logger.info("resumed interrupted state at iter %d", start_iter)
 
     # global batch = per-worker batch * workers * accumulation
     global_bs = (args.batch_size * trainer.algo_cfg.num_workers
@@ -142,11 +158,17 @@ def main(argv=None):
     done = start_iter
     try:
         while done < total:
+            if preempt is not None and preempt.should_stop():
+                break
             chunk = min(total - done, iters_per_epoch)
             m = trainer.train(data_iter, chunk, log_every=args.log_every,
                               logger=logger, metric_writer=writer,
-                              timers=timers, trace=trace, start_step=done)
-            done += chunk
+                              timers=timers, trace=trace, start_step=done,
+                              should_stop=(preempt.should_stop
+                                           if preempt else None))
+            done = trainer.last_step if preempt is not None else done + chunk
+            if not m:  # stopped before the first step of this chunk
+                break
             from oktopk_tpu import settings
             if settings.PROFILING_GRAD and is_rank0:
                 # gradient-stream snapshot (reference dumps raw .npy grads at
@@ -175,6 +197,13 @@ def main(argv=None):
             writer.close()
         if trace is not None:
             trace.close()
+
+    if preempt is not None:
+        # park-state/requeue (or clear on success) — reference
+        # main_bert.py:99-153, actually wired here.
+        from oktopk_tpu.train.preemption import epilogue
+        return epilogue(trainer.state, done, preempt, logger,
+                        rank=jax.process_index(), completed=done >= total)
     return 0
 
 

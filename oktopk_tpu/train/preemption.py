@@ -1,0 +1,151 @@
+"""SLURM preemption handling: graceful stop -> save -> requeue.
+
+Reference shape (BERT/bert/main_bert.py:73-203): SIGINT/SIGTERM/SIGUSR2 set
+a clean-exit Event, SIGUSR1 sets a requeue flag; ``save_interrupted_state``/
+``load_interrupted_state`` park the run state under
+``~/.interrupted_states/$SLURM_JOBID.pth``; ``requeue_job`` runs ``scontrol
+requeue`` on rank 0 after a barrier. The reference declares these but never
+wires them into its training loop (SURVEY.md §5.3) — here they are wired:
+the CLI drivers poll :meth:`PreemptionHandler.should_stop` between steps and
+run the save/requeue epilogue on the way out.
+
+On TPU pods the same signals arrive from the orchestrator (SLURM, GKE
+maintenance notices piped to a signal, etc.); state save uses the framework
+checkpoint (which, unlike the reference, includes compressor residuals and
+thresholds — SURVEY.md §5.4's gap).
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import subprocess
+import threading
+from typing import Iterable, Optional
+
+DEFAULT_STATE_DIR = os.environ.get(
+    "OKTOPK_STATE_DIR", os.path.expanduser("~/.interrupted_states"))
+
+
+class PreemptionHandler:
+    """Signal-driven stop/requeue flags.
+
+    ``exit_signals`` request a clean stop (checkpoint + exit);
+    ``requeue_signals`` additionally request ``scontrol requeue`` (SLURM's
+    pre-preemption warning, reference main_bert.py:84-88).
+    """
+
+    def __init__(self,
+                 exit_signals: Iterable[int] = (signal.SIGINT,
+                                                signal.SIGTERM,
+                                                signal.SIGUSR2),
+                 requeue_signals: Iterable[int] = (signal.SIGUSR1,)):
+        self._stop = threading.Event()
+        self._requeue = threading.Event()
+        self._prev = {}
+        for s in exit_signals:
+            self._prev[s] = signal.signal(s, self._on_exit_signal)
+        for s in requeue_signals:
+            self._prev[s] = signal.signal(s, self._on_requeue_signal)
+
+    # handlers run on the main thread; Event.set is async-signal-safe enough
+    def _on_exit_signal(self, signum, frame):
+        self._stop.set()
+
+    def _on_requeue_signal(self, signum, frame):
+        self._requeue.set()
+        self._stop.set()
+
+    def should_stop(self) -> bool:
+        return self._stop.is_set()
+
+    @property
+    def requeue_requested(self) -> bool:
+        return self._requeue.is_set()
+
+    def uninstall(self) -> None:
+        for s, prev in self._prev.items():
+            signal.signal(s, prev)
+        self._prev.clear()
+
+
+def interrupted_state_path(state_dir: str = DEFAULT_STATE_DIR,
+                           job_id: Optional[str] = None) -> str:
+    """``<state_dir>/<SLURM_JOBID|pid>.msgpack`` (reference
+    ``~/.interrupted_states/$SLURM_JOBID.pth``, main_bert.py:99-135)."""
+    jid = job_id or os.environ.get("SLURM_JOBID") or str(os.getpid())
+    return os.path.join(state_dir, f"{jid}.msgpack")
+
+
+def save_interrupted_state(state, step: int,
+                           state_dir: str = DEFAULT_STATE_DIR,
+                           job_id: Optional[str] = None) -> str:
+    """Park the full train state (params + optimizer + sparse residuals and
+    thresholds) for a requeued restart."""
+    from oktopk_tpu.train.checkpoint import save_checkpoint
+
+    path = interrupted_state_path(state_dir, job_id)
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    # save_checkpoint writes <dir>/<prefix>-<step>.msgpack atomically; park
+    # under a jobid-keyed subdir so the latest one is unambiguous.
+    d, base = os.path.split(path)
+    sub = os.path.join(d, base + ".d")
+    return save_checkpoint(sub, state, step)
+
+
+def load_interrupted_state(state_template,
+                           state_dir: str = DEFAULT_STATE_DIR,
+                           job_id: Optional[str] = None):
+    """(state, step) from a parked run, or None if there is nothing parked."""
+    from oktopk_tpu.train.checkpoint import restore_checkpoint
+
+    sub = interrupted_state_path(state_dir, job_id) + ".d"
+    if not os.path.isdir(sub):
+        return None
+    try:
+        return restore_checkpoint(sub, state_template)
+    except FileNotFoundError:
+        return None
+
+
+def clear_interrupted_state(state_dir: str = DEFAULT_STATE_DIR,
+                            job_id: Optional[str] = None) -> None:
+    import shutil
+
+    sub = interrupted_state_path(state_dir, job_id) + ".d"
+    shutil.rmtree(sub, ignore_errors=True)
+
+
+def epilogue(state, last_step: int, preempt: "PreemptionHandler", logger,
+             rank: int = 0, completed: bool = False,
+             state_dir: str = DEFAULT_STATE_DIR) -> int:
+    """Shared driver exit path. If ``preempt`` fired before the run finished:
+    park state (rank 0), requeue when requested, and return exit code 3.
+    Otherwise clear any parked state for this job id (a completed run must
+    not be resumable into a stale snapshot) and return 0."""
+    if preempt is not None and preempt.should_stop() and not completed:
+        if rank == 0:
+            path = save_interrupted_state(state, last_step,
+                                          state_dir=state_dir)
+            logger.info("preempted @ step %d: state parked at %s",
+                        last_step, path)
+        if preempt.requeue_requested and requeue_job(rank=rank):
+            logger.info("requeue issued")
+        return 3
+    if preempt is not None and rank == 0:
+        clear_interrupted_state(state_dir=state_dir)
+    return 0
+
+
+def requeue_job(rank: int = 0, job_id: Optional[str] = None,
+                runner=subprocess.run) -> bool:
+    """``scontrol requeue $SLURM_JOBID`` from rank 0 (reference
+    main_bert.py:138-153). Returns True if the requeue was issued."""
+    jid = job_id or os.environ.get("SLURM_JOBID")
+    if rank != 0 or not jid:
+        return False
+    try:
+        runner(["scontrol", "requeue", jid], check=True, timeout=60)
+        return True
+    except Exception:
+        return False

@@ -196,7 +196,8 @@ class Trainer:
 
     def train(self, data_iter: Iterable, num_iters: int,
               log_every: int = 50, logger=None, metric_writer=None,
-              timers=None, trace=None, start_step: int = 0):
+              timers=None, trace=None, start_step: int = 0,
+              should_stop=None):
         """Run ``num_iters`` steps (reference trainer.train(nsteps),
         VGG/dl_trainer.py:597). Returns the last metrics dict.
 
@@ -216,8 +217,14 @@ class Trainer:
             pending.clear()
 
         t0 = time.time()
+        self.last_step = start_step
         for i in range(num_iters):
+            if should_stop is not None and should_stop():
+                # preemption: break between steps so state is consistent
+                # (reference's clean-exit Event, BERT/bert/main_bert.py:73-96)
+                break
             step = start_step + i + 1
+            self.last_step = step
             if trace is not None:
                 trace.on_step(step)
             if timers is not None:

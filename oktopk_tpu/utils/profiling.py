@@ -88,15 +88,32 @@ class MetricWriter:
     def __init__(self, logdir: str, filename: str = "scalars.csv"):
         os.makedirs(logdir, exist_ok=True)
         self.path = os.path.join(logdir, filename)
+        self._existing_fields: Optional[list] = None
+        if os.path.exists(self.path) and os.path.getsize(self.path) > 0:
+            with open(self.path, newline="") as f:
+                header = next(csv.reader(f), None)
+            if header and header[0] == "step":
+                self._existing_fields = header[1:]
         self._file = open(self.path, "a", newline="")
         self._writer = csv.writer(self._file)
-        self._header_written = os.path.getsize(self.path) > 0
         self._fields: Optional[list] = None
 
     def write(self, step: int, scalars: Dict[str, float]) -> None:
         if self._fields is None:
             self._fields = sorted(scalars)
-            if not self._header_written:
+            if self._existing_fields is None:
+                self._writer.writerow(["step"] + self._fields)
+            elif self._existing_fields != self._fields:
+                # resuming with a different metric set: rotate to a fresh
+                # file rather than appending misaligned rows
+                self._file.close()
+                base, ext = os.path.splitext(self.path)
+                i = 1
+                while os.path.exists(f"{base}-{i}{ext}"):
+                    i += 1
+                self.path = f"{base}-{i}{ext}"
+                self._file = open(self.path, "a", newline="")
+                self._writer = csv.writer(self._file)
                 self._writer.writerow(["step"] + self._fields)
         row = [step] + [format(float(scalars.get(k, float("nan"))), ".8g")
                         for k in self._fields]
@@ -127,7 +144,9 @@ class TraceWindow:
     def on_step(self, step: int) -> None:
         import jax
 
-        if step == self.start_step and not self._active:
+        # range test, not equality: a resumed run may first observe a step
+        # past start_step and should still capture the remaining window
+        if self.start_step <= step < self.stop_step and not self._active:
             os.makedirs(self.logdir, exist_ok=True)
             jax.profiler.start_trace(self.logdir)
             self._active = True

@@ -63,6 +63,19 @@ class TestMetricWriter:
         assert sum(1 for r in rows if r and r[0] == "step") == 1
         assert len(rows) == 3
 
+    def test_append_with_changed_fields_rotates(self, tmp_path):
+        with MetricWriter(str(tmp_path)) as w:
+            w.write(1, {"a": 1.0})
+            first = w.path
+        with MetricWriter(str(tmp_path)) as w:
+            w.write(2, {"a": 2.0, "b": 3.0})
+            second = w.path
+        assert first != second
+        with open(second) as f:
+            rows = list(csv.reader(f))
+        assert rows[0] == ["step", "a", "b"]
+        assert rows[1][0] == "2"
+
 
 def test_trace_window_produces_trace(tmp_path):
     logdir = str(tmp_path / "trace")
