@@ -1,0 +1,158 @@
+// Prefetching shuffled batch loader, native runtime component.
+//
+// The reference feeds its trainers through torch DataLoader worker
+// processes (VGG/dl_trainer.py:286-343, num_workers=1 subprocess per rank).
+// TPU-native equivalent: the dataset lives in host RAM as one contiguous
+// array-of-records; a background pthread gathers shuffled records into a
+// ring of pre-allocated batch buffers so batch assembly fully overlaps the
+// device step and never contends for the Python GIL.
+//
+// Shuffle: Fisher-Yates over an index vector, reseeded per epoch from
+// (seed, epoch) via splitmix64 — deterministic and worker-shardable: with
+// shard/num_shards the loader walks only its residue class, matching the
+// reference's DistributedSampler partitioning (VGG/dl_trainer.py:336-343).
+
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <mutex>
+#include <thread>
+#include <vector>
+
+namespace {
+
+uint64_t splitmix64(uint64_t& x) {
+  x += 0x9E3779B97F4A7C15ULL;
+  uint64_t z = x;
+  z = (z ^ (z >> 30)) * 0xBF58476D1CE4E5B9ULL;
+  z = (z ^ (z >> 27)) * 0x94D049BB133111EBULL;
+  return z ^ (z >> 31);
+}
+
+struct Loader {
+  const uint8_t* data = nullptr;   // [n_items, item_bytes] borrowed buffer
+  int64_t n_items = 0;
+  int64_t item_bytes = 0;
+  int64_t batch = 0;
+  int64_t shard = 0, num_shards = 1;
+  uint64_t seed = 0;
+  bool drop_last = true;
+
+  // ring of prefetched batch buffers
+  std::vector<std::vector<uint8_t>> ring;
+  std::vector<int64_t> ring_count;     // records actually in each slot
+  size_t head = 0, tail = 0, filled = 0;
+  std::mutex mu;
+  std::condition_variable cv_full, cv_empty;
+  std::thread worker;
+  std::atomic<bool> stop{false};
+
+  // shuffle state (worker-side)
+  std::vector<int64_t> order;
+  size_t pos = 0;
+  uint64_t epoch = 0;
+
+  void reshuffle() {
+    int64_t total = n_items / num_shards;
+    order.resize(static_cast<size_t>(total));
+    for (int64_t i = 0; i < total; ++i)
+      order[static_cast<size_t>(i)] = i * num_shards + shard;
+    uint64_t s = seed * 0x9E3779B97F4A7C15ULL + epoch + 1;
+    for (size_t i = order.size(); i > 1; --i) {
+      size_t j = static_cast<size_t>(splitmix64(s) % i);
+      std::swap(order[i - 1], order[j]);
+    }
+    pos = 0;
+    ++epoch;
+  }
+
+  void fill_slot(size_t slot) {
+    int64_t count = 0;
+    uint8_t* dst = ring[slot].data();
+    while (count < batch) {
+      if (pos >= order.size()) {
+        if (drop_last || count == 0) reshuffle();
+        else break;  // partial final batch
+        if (order.empty()) break;  // shard holds zero records
+      }
+      int64_t rec = order[pos++];
+      std::memcpy(dst + count * item_bytes, data + rec * item_bytes,
+                  static_cast<size_t>(item_bytes));
+      ++count;
+    }
+    ring_count[slot] = count;
+  }
+
+  void run() {
+    for (;;) {
+      std::unique_lock<std::mutex> lk(mu);
+      cv_empty.wait(lk, [&] { return stop.load() || filled < ring.size(); });
+      if (stop.load()) return;
+      size_t slot = tail;
+      lk.unlock();
+      fill_slot(slot);           // copy outside the lock
+      lk.lock();
+      tail = (tail + 1) % ring.size();
+      ++filled;
+      cv_full.notify_one();
+    }
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+void* okn_loader_new(const uint8_t* data, int64_t n_items, int64_t item_bytes,
+                     int64_t batch, uint64_t seed, int64_t shard,
+                     int64_t num_shards, int64_t prefetch_depth,
+                     int drop_last) {
+  auto* l = new Loader;
+  l->data = data;
+  l->n_items = n_items;
+  l->item_bytes = item_bytes;
+  l->batch = batch;
+  l->seed = seed;
+  l->shard = shard;
+  l->num_shards = num_shards < 1 ? 1 : num_shards;
+  l->drop_last = drop_last != 0;
+  if (prefetch_depth < 1) prefetch_depth = 2;
+  l->ring.resize(static_cast<size_t>(prefetch_depth));
+  l->ring_count.assign(static_cast<size_t>(prefetch_depth), 0);
+  for (auto& b : l->ring)
+    b.resize(static_cast<size_t>(batch * item_bytes));
+  l->reshuffle();
+  l->worker = std::thread([l] { l->run(); });
+  return l;
+}
+
+// Blocks until a prefetched batch is ready; copies it into out
+// ([batch, item_bytes]) and returns the record count (< batch only for a
+// partial final batch with drop_last=0).
+int64_t okn_loader_next(void* h, uint8_t* out) {
+  auto* l = static_cast<Loader*>(h);
+  std::unique_lock<std::mutex> lk(l->mu);
+  l->cv_full.wait(lk, [&] { return l->filled > 0; });
+  size_t slot = l->head;
+  int64_t count = l->ring_count[slot];
+  std::memcpy(out, l->ring[slot].data(),
+              static_cast<size_t>(count * l->item_bytes));
+  l->head = (l->head + 1) % l->ring.size();
+  --l->filled;
+  l->cv_empty.notify_one();
+  return count;
+}
+
+void okn_loader_free(void* h) {
+  auto* l = static_cast<Loader*>(h);
+  {
+    std::lock_guard<std::mutex> lk(l->mu);
+    l->stop.store(true);
+  }
+  l->cv_empty.notify_all();
+  if (l->worker.joinable()) l->worker.join();
+  delete l;
+}
+
+}  // extern "C"

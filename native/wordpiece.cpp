@@ -1,0 +1,316 @@
+// WordPiece tokenizer, native runtime component.
+//
+// Matches oktopk_tpu/data/tokenization.py (itself modeled on the reference's
+// vendored BERT/bert/transformers/tokenization.py): BasicTokenizer
+// (lowercase, NFD accent strip, punctuation split) -> greedy longest-match
+// WordPiece over a vocab hash -> ids, plus the [CLS]/[SEP] pair encoding
+// with longest-first truncation (reference _truncate_seq_pair).
+//
+// Unicode scope: full UTF-8 iteration; lowercase/accent-strip cover ASCII +
+// Latin-1 supplement + Latin Extended-A (the ranges BERT's uncased English
+// vocab actually contains); other code points pass through unchanged and
+// split only on ASCII/Unicode-general-punctuation. The Python implementation
+// remains the reference for exotic scripts; parity tests pin the two
+// together on the Latin ranges.
+
+#include <algorithm>
+#include <cstdint>
+#include <cstring>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+struct WpTokenizer {
+  std::unordered_map<std::string, int32_t> vocab;
+  int32_t unk_id = 1;
+  bool do_lower = true;
+  int max_chars = 100;  // per-token cap (tokenization.py:57)
+};
+
+// ---- UTF-8 helpers ---------------------------------------------------------
+
+// Decode one code point starting at s[i]; advances i. Invalid bytes decode
+// as themselves (latin-1 style) so we never stall.
+uint32_t decode_utf8(const unsigned char* s, size_t n, size_t& i) {
+  unsigned char c = s[i];
+  if (c < 0x80) { i += 1; return c; }
+  if ((c >> 5) == 0x6 && i + 1 < n) {
+    uint32_t cp = ((c & 0x1F) << 6) | (s[i + 1] & 0x3F);
+    i += 2; return cp;
+  }
+  if ((c >> 4) == 0xE && i + 2 < n) {
+    uint32_t cp = ((c & 0x0F) << 12) | ((s[i + 1] & 0x3F) << 6)
+                  | (s[i + 2] & 0x3F);
+    i += 3; return cp;
+  }
+  if ((c >> 3) == 0x1E && i + 3 < n) {
+    uint32_t cp = ((c & 0x07) << 18) | ((s[i + 1] & 0x3F) << 12)
+                  | ((s[i + 2] & 0x3F) << 6) | (s[i + 3] & 0x3F);
+    i += 4; return cp;
+  }
+  i += 1;
+  return c;
+}
+
+void append_utf8(std::string& out, uint32_t cp) {
+  if (cp < 0x80) {
+    out.push_back(static_cast<char>(cp));
+  } else if (cp < 0x800) {
+    out.push_back(static_cast<char>(0xC0 | (cp >> 6)));
+    out.push_back(static_cast<char>(0x80 | (cp & 0x3F)));
+  } else if (cp < 0x10000) {
+    out.push_back(static_cast<char>(0xE0 | (cp >> 12)));
+    out.push_back(static_cast<char>(0x80 | ((cp >> 6) & 0x3F)));
+    out.push_back(static_cast<char>(0x80 | (cp & 0x3F)));
+  } else {
+    out.push_back(static_cast<char>(0xF0 | (cp >> 18)));
+    out.push_back(static_cast<char>(0x80 | ((cp >> 12) & 0x3F)));
+    out.push_back(static_cast<char>(0x80 | ((cp >> 6) & 0x3F)));
+    out.push_back(static_cast<char>(0x80 | (cp & 0x3F)));
+  }
+}
+
+// Lowercase + accent-strip one code point (0 = drop, e.g. combining marks).
+// Covers ASCII, Latin-1 supplement and Latin Extended-A; mirrors Python's
+// lower() + NFD + remove-Mn pipeline on those ranges exactly: only letters
+// with a canonical decomposition lose their accent, the rest just lowercase
+// (e.g. Đ -> đ, Ł -> ł, Ø -> ø — none of which NFD-decompose).
+uint32_t lower_strip(uint32_t cp) {
+  if (cp >= 'A' && cp <= 'Z') return cp + 32;
+  if (cp >= 0x300 && cp <= 0x36F) return 0;  // combining marks (Mn)
+
+  if (cp >= 0xC0 && cp <= 0xFF) {  // Latin-1 supplement
+    switch (cp) {
+      case 0xC6: case 0xE6: return 0xE6;  // ae ligature (no decomposition)
+      case 0xD0: case 0xF0: return 0xF0;  // eth
+      case 0xD7: return 0xD7;             // multiplication sign
+      case 0xD8: case 0xF8: return 0xF8;  // o-slash
+      case 0xDE: case 0xFE: return 0xFE;  // thorn
+      case 0xDF: return 0xDF;             // sharp s
+      case 0xF7: return 0xF7;             // division sign
+      default: break;
+    }
+    uint32_t lo = cp < 0xE0 ? cp + 0x20 : cp;  // lowercase first
+    // decomposable accented letters -> base
+    if (lo >= 0xE0 && lo <= 0xE5) return 'a';
+    if (lo == 0xE7) return 'c';
+    if (lo >= 0xE8 && lo <= 0xEB) return 'e';
+    if (lo >= 0xEC && lo <= 0xEF) return 'i';
+    if (lo == 0xF1) return 'n';
+    if ((lo >= 0xF2 && lo <= 0xF6)) return 'o';
+    if (lo >= 0xF9 && lo <= 0xFC) return 'u';
+    if (lo == 0xFD || lo == 0xFF) return 'y';
+    return lo;
+  }
+
+  if (cp >= 0x100 && cp <= 0x17F) {  // Latin Extended-A
+    switch (cp) {  // letters with NO canonical decomposition: lowercase only
+      case 0x110: case 0x111: return 0x111;  // d-stroke
+      case 0x126: case 0x127: return 0x127;  // h-stroke
+      case 0x131: return 0x131;              // dotless i
+      case 0x132: case 0x133: return 0x133;  // ij ligature
+      case 0x138: return 0x138;              // kra
+      case 0x13F: case 0x140: return 0x140;  // l-middle-dot (NFKD only)
+      case 0x141: case 0x142: return 0x142;  // l-stroke
+      case 0x149: return 0x149;              // 'n (NFKD only)
+      case 0x14A: case 0x14B: return 0x14B;  // eng
+      case 0x152: case 0x153: return 0x153;  // oe ligature
+      case 0x166: case 0x167: return 0x167;  // t-stroke
+      case 0x17F: return 0x17F;              // long s (NFKD only)
+      default: break;
+    }
+    if (cp <= 0x105) return 'a';
+    if (cp <= 0x10D) return 'c';
+    if (cp <= 0x10F) return 'd';
+    if (cp <= 0x11B) return 'e';
+    if (cp <= 0x123) return 'g';
+    if (cp <= 0x125) return 'h';
+    if (cp <= 0x130) return 'i';
+    if (cp <= 0x135) return 'j';
+    if (cp <= 0x137) return 'k';
+    if (cp <= 0x13E) return 'l';
+    if (cp <= 0x148) return 'n';
+    if (cp <= 0x151) return 'o';
+    if (cp <= 0x159) return 'r';
+    if (cp <= 0x161) return 's';
+    if (cp <= 0x165) return 't';
+    if (cp <= 0x173) return 'u';
+    if (cp <= 0x175) return 'w';
+    if (cp <= 0x178) return 'y';
+    return 'z';
+  }
+  return cp;
+}
+
+bool is_space(uint32_t cp) {
+  return cp == ' ' || cp == '\t' || cp == '\n' || cp == '\r' || cp == 0x0B
+         || cp == 0x0C || cp == 0xA0 || cp == 0x2028 || cp == 0x2029
+         || (cp >= 0x2000 && cp <= 0x200A) || cp == 0x3000;
+}
+
+bool is_punct(uint32_t cp) {
+  // ASCII punctuation blocks (tokenization.py:15-20) ...
+  if ((cp >= 33 && cp <= 47) || (cp >= 58 && cp <= 64)
+      || (cp >= 91 && cp <= 96) || (cp >= 123 && cp <= 126))
+    return true;
+  // ... plus General Punctuation and CJK punctuation (category P)
+  return (cp >= 0x2010 && cp <= 0x2027) || (cp >= 0x2030 && cp <= 0x205E)
+         || (cp >= 0x3001 && cp <= 0x3011) || (cp >= 0xFF01 && cp <= 0xFF0F);
+}
+
+// BasicTokenizer: split text into words/punctuation (tokenization.py:23-49).
+std::vector<std::string> basic_tokenize(const WpTokenizer& t,
+                                        const char* text) {
+  const auto* s = reinterpret_cast<const unsigned char*>(text);
+  size_t n = std::strlen(text);
+  std::vector<std::string> out;
+  std::string word;
+  size_t i = 0;
+  while (i < n) {
+    uint32_t cp = decode_utf8(s, n, i);
+    if (t.do_lower) {
+      cp = lower_strip(cp);
+      if (cp == 0) continue;  // stripped combining mark
+    }
+    if (is_space(cp)) {
+      if (!word.empty()) { out.push_back(word); word.clear(); }
+    } else if (is_punct(cp)) {
+      if (!word.empty()) { out.push_back(word); word.clear(); }
+      std::string p;
+      append_utf8(p, cp);
+      out.push_back(p);
+    } else {
+      append_utf8(word, cp);
+    }
+  }
+  if (!word.empty()) out.push_back(word);
+  return out;
+}
+
+size_t utf8_len(const std::string& s) {
+  size_t count = 0;
+  for (unsigned char c : s)
+    if ((c & 0xC0) != 0x80) ++count;
+  return count;
+}
+
+// byte offsets of each code-point boundary (for longest-match backoff)
+std::vector<size_t> char_offsets(const std::string& s) {
+  std::vector<size_t> offs;
+  for (size_t i = 0; i < s.size(); ++i)
+    if ((static_cast<unsigned char>(s[i]) & 0xC0) != 0x80) offs.push_back(i);
+  offs.push_back(s.size());
+  return offs;
+}
+
+// Greedy longest-match WordPiece (tokenization.py:52-78) -> ids.
+void wordpiece_ids(const WpTokenizer& t, const std::string& token,
+                   std::vector<int32_t>& out) {
+  if (utf8_len(token) > static_cast<size_t>(t.max_chars)) {
+    out.push_back(t.unk_id);
+    return;
+  }
+  auto offs = char_offsets(token);
+  size_t nchars = offs.size() - 1;
+  std::vector<int32_t> pieces;
+  size_t start = 0;
+  while (start < nchars) {
+    size_t end = nchars;
+    int32_t cur = -1;
+    while (start < end) {
+      std::string sub = token.substr(offs[start], offs[end] - offs[start]);
+      if (start > 0) sub = "##" + sub;
+      auto it = t.vocab.find(sub);
+      if (it != t.vocab.end()) { cur = it->second; break; }
+      --end;
+    }
+    if (cur < 0) {
+      out.push_back(t.unk_id);
+      return;
+    }
+    pieces.push_back(cur);
+    start = end;
+  }
+  out.insert(out.end(), pieces.begin(), pieces.end());
+}
+
+void encode_text(const WpTokenizer& t, const char* text,
+                 std::vector<int32_t>& out) {
+  for (const auto& tok : basic_tokenize(t, text)) wordpiece_ids(t, tok, out);
+}
+
+}  // namespace
+
+extern "C" {
+
+void* okn_wp_new_from_buffer(const char* buf, int64_t len, int do_lower) {
+  auto* t = new WpTokenizer;
+  t->do_lower = do_lower != 0;
+  std::string line;
+  int32_t idx = 0;
+  for (int64_t i = 0; i <= len; ++i) {
+    if (i == len || buf[i] == '\n') {
+      // rstrip("\n") semantics: the line text is everything up to \n
+      t->vocab.emplace(line, idx++);
+      line.clear();
+      if (i == len) break;
+    } else {
+      line.push_back(buf[i]);
+    }
+  }
+  auto it = t->vocab.find("[UNK]");
+  t->unk_id = it == t->vocab.end() ? 0 : it->second;
+  return t;
+}
+
+void okn_wp_free(void* h) { delete static_cast<WpTokenizer*>(h); }
+
+int64_t okn_wp_vocab_size(void* h) {
+  return static_cast<WpTokenizer*>(h)->vocab.size();
+}
+
+// Tokenize+encode `text`; writes at most max_out ids. Returns the number of
+// ids produced (may exceed max_out to signal truncation).
+int64_t okn_wp_encode(void* h, const char* text, int32_t* out_ids,
+                      int64_t max_out) {
+  auto* t = static_cast<WpTokenizer*>(h);
+  std::vector<int32_t> ids;
+  encode_text(*t, text, ids);
+  int64_t n = static_cast<int64_t>(ids.size());
+  std::memcpy(out_ids, ids.data(),
+              sizeof(int32_t) * static_cast<size_t>(std::min(n, max_out)));
+  return n;
+}
+
+// [CLS] a [SEP] (b [SEP]) with longest-first pair truncation and padding
+// (tokenization.py:119-138). Buffers must hold max_len entries. Returns the
+// unpadded length.
+int64_t okn_wp_encode_pair(void* h, const char* text_a, const char* text_b,
+                           int64_t max_len, int32_t cls_id, int32_t sep_id,
+                           int32_t* ids, int32_t* types, int32_t* mask) {
+  auto* t = static_cast<WpTokenizer*>(h);
+  std::vector<int32_t> a, b;
+  encode_text(*t, text_a, a);
+  bool has_b = text_b != nullptr && text_b[0] != '\0';
+  if (has_b) encode_text(*t, text_b, b);
+  int64_t budget = max_len - (has_b ? 3 : 2);
+  if (budget < 0) budget = 0;
+  while (static_cast<int64_t>(a.size() + b.size()) > budget) {
+    if (a.size() > b.size()) a.pop_back(); else b.pop_back();
+  }
+  int64_t pos = 0;
+  ids[pos] = cls_id; types[pos] = 0; mask[pos] = 1; ++pos;
+  for (int32_t v : a) { ids[pos] = v; types[pos] = 0; mask[pos] = 1; ++pos; }
+  ids[pos] = sep_id; types[pos] = 0; mask[pos] = 1; ++pos;
+  if (has_b) {
+    for (int32_t v : b) { ids[pos] = v; types[pos] = 1; mask[pos] = 1; ++pos; }
+    ids[pos] = sep_id; types[pos] = 1; mask[pos] = 1; ++pos;
+  }
+  int64_t used = pos;
+  for (; pos < max_len; ++pos) { ids[pos] = 0; types[pos] = 0; mask[pos] = 0; }
+  return used;
+}
+
+}  // extern "C"

@@ -27,13 +27,29 @@ CIFAR_STD = np.array([0.2023, 0.1994, 0.2010], np.float32)
 
 def _batched(x: Dict[str, np.ndarray], batch_size: int, seed: int,
              shuffle: bool = True) -> Iterator[Dict[str, np.ndarray]]:
-    n = len(next(iter(x.values())))
-    rng = np.random.RandomState(seed)
-    while True:
-        order = rng.permutation(n) if shuffle else np.arange(n)
-        for i in range(0, n - batch_size + 1, batch_size):
-            sel = order[i:i + batch_size]
-            yield {k: v[sel] for k, v in x.items()}
+    """Shuffled epoch batches. Training iterators prefer the native
+    prefetching loader (C++ background thread, oktopk_tpu/native/loader.py
+    — the torch-DataLoader-worker replacement); falls back to the Python
+    batcher when the toolchain is absent."""
+    if shuffle:
+        try:
+            from oktopk_tpu.native.loader import make_prefetch_iter
+            it = make_prefetch_iter(x, batch_size, seed=seed)
+            if it is not None:
+                return it
+        except Exception:
+            pass
+
+    def gen():
+        n = len(next(iter(x.values())))
+        rng = np.random.RandomState(seed)
+        while True:
+            order = rng.permutation(n) if shuffle else np.arange(n)
+            for i in range(0, n - batch_size + 1, batch_size):
+                sel = order[i:i + batch_size]
+                yield {k: v[sel] for k, v in x.items()}
+
+    return gen()
 
 
 def load_cifar10(path: str, split: str = "train"):
@@ -97,8 +113,18 @@ def make_dataset(dataset: str, dnn: str, batch_size: int,
             if not os.path.exists(corpus):
                 raise FileNotFoundError(corpus)
             vocab_file = os.path.join(path, "vocab.txt")
-            tok = FullTokenizer(
-                vocab_file if os.path.exists(vocab_file) else None)
+            tok = None
+            if os.path.exists(vocab_file):
+                try:  # native WordPiece (C++) when the toolchain allows
+                    from oktopk_tpu.native.tokenizer import NativeTokenizer
+                    nat = NativeTokenizer(vocab_file)
+                    if nat.native:
+                        tok = nat
+                except Exception:
+                    pass
+            if tok is None:
+                tok = FullTokenizer(
+                    vocab_file if os.path.exists(vocab_file) else None)
             vocab_size = 1024 if dnn == "bert_tiny" else 30522
             seq = 32 if dnn == "bert_tiny" else 128
             return (pretrain_iterator(corpus, tok, batch_size, seq,

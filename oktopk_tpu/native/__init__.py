@@ -1,0 +1,104 @@
+"""Native (C++) runtime components, bound through ctypes.
+
+The reference delegates its native performance to external libraries (MPI,
+cuDNN, apex — SURVEY.md §2.4); the TPU build keeps the *compute* path in
+XLA and implements the host-side runtime pieces natively here:
+
+- ``native/wordpiece.cpp`` — WordPiece tokenizer (the vendored
+  BERT/bert/transformers/tokenization.py hot loop);
+- ``native/prefetch.cpp`` — background-thread shuffled batch loader (the
+  torch DataLoader worker replacement, VGG/dl_trainer.py:286-343).
+
+The library is compiled on first use with the in-image g++ (no pip deps;
+pybind11 intentionally avoided — plain C ABI + ctypes). Every consumer
+falls back to the pure-Python implementation when a toolchain is missing,
+so the framework never hard-requires the native path.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC_DIR = os.path.join(os.path.dirname(os.path.dirname(_HERE)), "native")
+_LIB_PATH = os.path.join(_HERE, "liboktopk_native.so")
+
+_lock = threading.Lock()
+_lib = None
+_build_error: str | None = None
+
+
+def _needs_build() -> bool:
+    if not os.path.exists(_LIB_PATH):
+        return True
+    lib_mtime = os.path.getmtime(_LIB_PATH)
+    for f in os.listdir(_SRC_DIR):
+        if f.endswith(".cpp") and os.path.getmtime(
+                os.path.join(_SRC_DIR, f)) > lib_mtime:
+            return True
+    return False
+
+
+def _build() -> None:
+    srcs = sorted(
+        os.path.join(_SRC_DIR, f) for f in os.listdir(_SRC_DIR)
+        if f.endswith(".cpp"))
+    cmd = ["g++", "-O3", "-fPIC", "-std=c++17", "-shared", "-pthread",
+           "-o", _LIB_PATH] + srcs
+    subprocess.run(cmd, check=True, capture_output=True, text=True,
+                   timeout=300)
+
+
+def _declare(lib: ctypes.CDLL) -> None:
+    i64, i32p = ctypes.c_int64, ctypes.POINTER(ctypes.c_int32)
+    lib.okn_wp_new_from_buffer.restype = ctypes.c_void_p
+    lib.okn_wp_new_from_buffer.argtypes = [ctypes.c_char_p, i64, ctypes.c_int]
+    lib.okn_wp_free.argtypes = [ctypes.c_void_p]
+    lib.okn_wp_vocab_size.restype = i64
+    lib.okn_wp_vocab_size.argtypes = [ctypes.c_void_p]
+    lib.okn_wp_encode.restype = i64
+    lib.okn_wp_encode.argtypes = [ctypes.c_void_p, ctypes.c_char_p, i32p, i64]
+    lib.okn_wp_encode_pair.restype = i64
+    lib.okn_wp_encode_pair.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p, i64,
+        ctypes.c_int32, ctypes.c_int32, i32p, i32p, i32p]
+
+    u8p = ctypes.POINTER(ctypes.c_uint8)
+    lib.okn_loader_new.restype = ctypes.c_void_p
+    lib.okn_loader_new.argtypes = [u8p, i64, i64, i64, ctypes.c_uint64,
+                                   i64, i64, i64, ctypes.c_int]
+    lib.okn_loader_next.restype = i64
+    lib.okn_loader_next.argtypes = [ctypes.c_void_p, u8p]
+    lib.okn_loader_free.argtypes = [ctypes.c_void_p]
+
+
+def load():
+    """The shared library, building it if needed; None when unavailable
+    (no g++, sandboxed filesystem, OKTOPK_NO_NATIVE=1)."""
+    global _lib, _build_error
+    if os.environ.get("OKTOPK_NO_NATIVE") == "1":
+        return None
+    with _lock:
+        if _lib is not None or _build_error is not None:
+            return _lib
+        try:
+            if _needs_build():
+                _build()
+            _lib = ctypes.CDLL(_LIB_PATH)
+            _declare(_lib)
+        except Exception as e:  # toolchain missing, etc. — fall back
+            _build_error = str(e)
+            _lib = None
+        return _lib
+
+
+def available() -> bool:
+    return load() is not None
+
+
+def build_error() -> str | None:
+    load()
+    return _build_error

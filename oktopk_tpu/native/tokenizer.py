@@ -1,0 +1,99 @@
+"""ctypes wrapper over the native WordPiece tokenizer
+(native/wordpiece.cpp), API-compatible with
+``oktopk_tpu.data.tokenization.FullTokenizer`` for the encoding entry
+points the pipelines use (``encode`` and ``encode_pair``)."""
+
+from __future__ import annotations
+
+import ctypes
+import os
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from oktopk_tpu.native import load
+
+
+class NativeTokenizer:
+    """Vocab-file WordPiece encoder backed by the C++ implementation.
+
+    Falls back transparently to the Python FullTokenizer when the native
+    library is unavailable (``.native`` tells which one is active).
+    """
+
+    def __init__(self, vocab_file: str, do_lower_case: bool = True,
+                 max_ids: int = 4096):
+        with open(vocab_file, encoding="utf-8") as f:
+            vocab_text = f.read()
+        lines = vocab_text.split("\n")
+        if lines and lines[-1] == "":
+            lines.pop()  # trailing newline is not an entry
+        self._vocab = {tok: i for i, tok in enumerate(lines)}
+        self.cls_id = self._vocab.get("[CLS]", 2)
+        self.sep_id = self._vocab.get("[SEP]", 3)
+        self._max_ids = max_ids
+
+        lib = load()
+        self._lib = lib
+        self._handle = None
+        if lib is not None:
+            buf = "\n".join(lines).encode("utf-8")
+            self._handle = lib.okn_wp_new_from_buffer(
+                buf, len(buf), 1 if do_lower_case else 0)
+        if self._handle is None:
+            from oktopk_tpu.data.tokenization import FullTokenizer
+            self._py = FullTokenizer(vocab_file, do_lower_case)
+        else:
+            self._py = None
+
+    @property
+    def native(self) -> bool:
+        return self._handle is not None
+
+    @property
+    def vocab(self):
+        """token -> id mapping (drop-in for FullTokenizer.vocab)."""
+        return self._vocab
+
+    @property
+    def vocab_size(self) -> int:
+        return len(self._vocab)
+
+    def encode(self, text: str) -> List[int]:
+        """text -> wordpiece ids (no specials)."""
+        if self._handle is None:
+            return self._py.convert_tokens_to_ids(self._py.tokenize(text))
+        utf8 = text.encode("utf-8")
+        cap = self._max_ids
+        while True:
+            out = np.empty(cap, np.int32)
+            n = self._lib.okn_wp_encode(
+                self._handle, utf8,
+                out.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)), cap)
+            if n <= cap:  # n > cap signals truncation: grow and retry
+                return out[:n].tolist()
+            cap = int(n)
+
+    def encode_pair(self, text_a: str, text_b: Optional[str],
+                    max_len: int) -> Tuple[List[int], List[int], List[int]]:
+        """[CLS] a [SEP] (b [SEP]) padded to max_len ->
+        (input_ids, token_type_ids, attention_mask)."""
+        if self._handle is None:
+            return self._py.encode_pair(text_a, text_b, max_len)
+        ids = np.empty(max_len, np.int32)
+        types = np.empty(max_len, np.int32)
+        mask = np.empty(max_len, np.int32)
+        p = ctypes.POINTER(ctypes.c_int32)
+        self._lib.okn_wp_encode_pair(
+            self._handle, text_a.encode("utf-8"),
+            (text_b or "").encode("utf-8"), max_len,
+            self.cls_id, self.sep_id,
+            ids.ctypes.data_as(p), types.ctypes.data_as(p),
+            mask.ctypes.data_as(p))
+        return ids.tolist(), types.tolist(), mask.tolist()
+
+    def __del__(self):
+        lib, handle = getattr(self, "_lib", None), getattr(self, "_handle",
+                                                           None)
+        if lib is not None and handle is not None:
+            lib.okn_wp_free(handle)

@@ -10,16 +10,14 @@ dicts, VGG/compression.py:28,170) — a resume silently resets error feedback
 batch stats, residual, thresholds, boundaries, step counters — is one pytree,
 serialised with flax msgpack.
 
-Also provides the SLURM-preemption shape the reference declares
-(save-on-signal -> requeue, BERT/bert/main_bert.py:73-153):
-``install_preempt_handler`` saves an interrupted state on SIGTERM/SIGUSR1.
+Preemption (save-on-signal -> requeue, reference
+BERT/bert/main_bert.py:73-153) lives in ``oktopk_tpu.train.preemption``.
 """
 
 from __future__ import annotations
 
 import os
-import signal
-from typing import Any, Callable, Optional, Tuple
+from typing import Any, Optional, Tuple
 
 import flax.serialization
 import jax
@@ -119,15 +117,3 @@ def restore_checkpoint(ckpt_dir_or_file: str, state_template: Any,
     return payload["state"], int(payload["step"])
 
 
-def install_preempt_handler(save_fn: Callable[[], None],
-                            signals=(signal.SIGTERM, signal.SIGUSR1)):
-    """On preemption signals, save state then re-raise the default behaviour
-    (reference save_interrupted_state/requeue shape,
-    BERT/bert/main_bert.py:99-153; requeue itself belongs to the scheduler)."""
-    def handler(signum, frame):
-        save_fn()
-        signal.signal(signum, signal.SIG_DFL)
-        os.kill(os.getpid(), signum)
-
-    for s in signals:
-        signal.signal(s, handler)

@@ -71,9 +71,14 @@ class PreemptionHandler:
 
 def interrupted_state_path(state_dir: str = DEFAULT_STATE_DIR,
                            job_id: Optional[str] = None) -> str:
-    """``<state_dir>/<SLURM_JOBID|pid>.msgpack`` (reference
-    ``~/.interrupted_states/$SLURM_JOBID.pth``, main_bert.py:99-135)."""
-    jid = job_id or os.environ.get("SLURM_JOBID") or str(os.getpid())
+    """``<state_dir>/<job id>.msgpack`` (reference
+    ``~/.interrupted_states/$SLURM_JOBID.pth``, main_bert.py:99-135).
+
+    Job id precedence: explicit arg > SLURM_JOBID > OKTOPK_RUN_ID >
+    ``"local"``. The last is a *stable* fallback (never the pid): a
+    restarted non-SLURM process must find the state its predecessor parked."""
+    jid = (job_id or os.environ.get("SLURM_JOBID")
+           or os.environ.get("OKTOPK_RUN_ID") or "local")
     return os.path.join(state_dir, f"{jid}.msgpack")
 
 

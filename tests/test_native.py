@@ -1,0 +1,174 @@
+"""Native (C++) runtime component tests: WordPiece tokenizer parity vs the
+pure-Python implementation, and the prefetching batch loader
+(native/wordpiece.cpp, native/prefetch.cpp)."""
+
+import numpy as np
+import pytest
+
+from oktopk_tpu.native import available, build_error
+
+pytestmark = pytest.mark.skipif(
+    not available(), reason=f"native lib unavailable: {build_error()}")
+
+VOCAB = [
+    "[PAD]", "[UNK]", "[CLS]", "[SEP]", "[MASK]",
+    "the", "quick", "brown", "fox", "jump", "##s", "##ed", "##ing",
+    "over", "lazy", "dog", "un", "##aff", "##able", "run", "##ner",
+    "hello", "world", ",", ".", "!", "?", "'", "2", "##0", "##2",
+    "naive", "uber", "##lin",
+]
+
+
+@pytest.fixture(scope="module")
+def vocab_file(tmp_path_factory):
+    p = tmp_path_factory.mktemp("vocab") / "vocab.txt"
+    p.write_text("\n".join(VOCAB) + "\n", encoding="utf-8")
+    return str(p)
+
+
+@pytest.fixture(scope="module")
+def both(vocab_file):
+    from oktopk_tpu.data.tokenization import FullTokenizer
+    from oktopk_tpu.native.tokenizer import NativeTokenizer
+
+    nat = NativeTokenizer(vocab_file)
+    assert nat.native, "ctypes path not active"
+    return nat, FullTokenizer(vocab_file)
+
+
+PARITY_TEXTS = [
+    "The quick brown fox jumps over the lazy dog",
+    "hello, world!  RUNNER running",
+    "unaffable",
+    "deadbeef zzz",                       # -> [UNK]s
+    "hello...world",                      # punctuation runs
+    "  leading and trailing   ",
+    "",
+    "2022",
+    "Hello WORLD'S",
+    "naïve Über",               # naïve Über: accent strip + lower
+    "résumé",                   # é -> e (decomposable)
+    "Łukasz",                        # Ł has no NFD decomposition
+]
+
+
+class TestTokenizerParity:
+    @pytest.mark.parametrize("text", PARITY_TEXTS)
+    def test_encode_matches_python(self, both, text):
+        nat, py = both
+        expected = py.convert_tokens_to_ids(py.tokenize(text))
+        assert nat.encode(text) == expected, text
+
+    @pytest.mark.parametrize("text_b", [None, "the lazy dog"])
+    def test_encode_pair_matches_python(self, both, text_b):
+        nat, py = both
+        a = "the quick brown fox"
+        assert nat.encode_pair(a, text_b, 16) == py.encode_pair(a, text_b, 16)
+
+    def test_pair_truncation_longest_first(self, both):
+        nat, py = both
+        a = "the quick brown fox jumps over the lazy dog " * 3
+        b = "hello world"
+        for max_len in (8, 12, 20):
+            assert (nat.encode_pair(a, b, max_len)
+                    == py.encode_pair(a, b, max_len)), max_len
+
+    def test_long_token_is_unk(self, both):
+        nat, py = both
+        text = "a" * 150
+        assert nat.encode(text) == py.convert_tokens_to_ids(
+            py.tokenize(text))
+
+    def test_vocab_size(self, both, vocab_file):
+        nat, _ = both
+        assert nat.vocab_size == len(VOCAB)
+
+
+class TestPrefetchLoader:
+    def _arrays(self, n=64):
+        return {
+            "image": (np.arange(n * 6, dtype=np.uint8).reshape(n, 2, 3)),
+            "label": np.arange(n, dtype=np.int64),
+        }
+
+    def test_batch_shapes_and_dtypes(self):
+        from oktopk_tpu.native.loader import PrefetchLoader
+
+        dl = PrefetchLoader(self._arrays(), batch_size=8, seed=1)
+        b = dl.next_batch()
+        assert b["image"].shape == (8, 2, 3) and b["image"].dtype == np.uint8
+        assert b["label"].shape == (8,) and b["label"].dtype == np.int64
+        dl.close()
+
+    def test_epoch_covers_every_record_once(self):
+        from oktopk_tpu.native.loader import PrefetchLoader
+
+        n, bs = 64, 8
+        dl = PrefetchLoader(self._arrays(n), batch_size=bs, seed=3)
+        seen = []
+        for _ in range(n // bs):
+            seen.extend(dl.next_batch()["label"].tolist())
+        assert sorted(seen) == list(range(n))
+        assert seen != list(range(n)), "epoch was not shuffled"
+        dl.close()
+
+    def test_records_keep_field_alignment(self):
+        from oktopk_tpu.native.loader import PrefetchLoader
+
+        n = 32
+        arrays = {"x": np.arange(n, dtype=np.float32) * 2.0,
+                  "label": np.arange(n, dtype=np.int64)}
+        dl = PrefetchLoader(arrays, batch_size=4, seed=0)
+        for _ in range(8):
+            b = dl.next_batch()
+            np.testing.assert_allclose(b["x"], b["label"] * 2.0)
+        dl.close()
+
+    def test_determinism_same_seed(self):
+        from oktopk_tpu.native.loader import PrefetchLoader
+
+        def first_epoch(seed):
+            dl = PrefetchLoader(self._arrays(), batch_size=8, seed=seed)
+            out = [tuple(dl.next_batch()["label"].tolist())
+                   for _ in range(8)]
+            dl.close()
+            return out
+
+        assert first_epoch(7) == first_epoch(7)
+        assert first_epoch(7) != first_epoch(8)
+
+    def test_sharding_partitions_dataset(self):
+        from oktopk_tpu.native.loader import PrefetchLoader
+
+        n, bs = 64, 8
+        seen = []
+        for shard in range(2):
+            dl = PrefetchLoader(self._arrays(n), batch_size=bs, seed=5,
+                                shard=shard, num_shards=2)
+            for _ in range(n // 2 // bs):
+                seen.extend(dl.next_batch()["label"].tolist())
+            dl.close()
+        assert sorted(seen) == list(range(n))
+
+    def test_reshuffles_across_epochs(self):
+        from oktopk_tpu.native.loader import PrefetchLoader
+
+        n, bs = 32, 8
+        dl = PrefetchLoader(self._arrays(n), batch_size=bs, seed=9)
+        e1 = [tuple(dl.next_batch()["label"].tolist())
+              for _ in range(n // bs)]
+        e2 = [tuple(dl.next_batch()["label"].tolist())
+              for _ in range(n // bs)]
+        assert sorted(sum(map(list, e1), [])) == list(range(n))
+        assert sorted(sum(map(list, e2), [])) == list(range(n))
+        assert e1 != e2
+        dl.close()
+
+    def test_many_batches_no_deadlock(self):
+        from oktopk_tpu.native.loader import PrefetchLoader
+
+        dl = PrefetchLoader(self._arrays(16), batch_size=16, seed=0,
+                            prefetch_depth=4)
+        for _ in range(200):
+            dl.next_batch()
+        dl.close()
