@@ -70,10 +70,16 @@ struct Loader {
   void fill_slot(size_t slot) {
     int64_t count = 0;
     uint8_t* dst = ring[slot].data();
+    // drop_last: discard the epoch tail *before* starting a batch so one
+    // batch never mixes records of two epochs (torch-DataLoader semantics;
+    // only a dataset smaller than one batch still wraps mid-batch)
+    if (drop_last && order.size() - pos < static_cast<size_t>(batch)
+        && order.size() >= static_cast<size_t>(batch))
+      reshuffle();
     while (count < batch) {
       if (pos >= order.size()) {
-        if (drop_last || count == 0) reshuffle();
-        else break;  // partial final batch
+        if (!drop_last && count > 0) break;  // partial final batch
+        reshuffle();
         if (order.empty()) break;  // shard holds zero records
       }
       int64_t rec = order[pos++];

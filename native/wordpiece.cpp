@@ -291,15 +291,21 @@ int64_t okn_wp_encode_pair(void* h, const char* text_a, const char* text_b,
                            int64_t max_len, int32_t cls_id, int32_t sep_id,
                            int32_t* ids, int32_t* types, int32_t* mask) {
   auto* t = static_cast<WpTokenizer*>(h);
+  if (max_len < 2) return 0;  // no room for even [CLS] [SEP]
   std::vector<int32_t> a, b;
   encode_text(*t, text_a, a);
-  bool has_b = text_b != nullptr && text_b[0] != '\0';
-  if (has_b) encode_text(*t, text_b, b);
+  if (text_b != nullptr && text_b[0] != '\0') encode_text(*t, text_b, b);
+  // like the Python reference, pair mode is decided by the *tokenized*
+  // second text (whitespace-only text_b has no second segment)
+  bool has_b = !b.empty();
+  if (has_b && max_len < 3) { b.clear(); has_b = false; }
   int64_t budget = max_len - (has_b ? 3 : 2);
-  if (budget < 0) budget = 0;
   while (static_cast<int64_t>(a.size() + b.size()) > budget) {
     if (a.size() > b.size()) a.pop_back(); else b.pop_back();
   }
+  // Python re-tests `if tb:` AFTER truncation: a fully-truncated second
+  // segment emits no second [SEP] (budget stays the 3-special one)
+  if (b.empty()) has_b = false;
   int64_t pos = 0;
   ids[pos] = cls_id; types[pos] = 0; mask[pos] = 1; ++pos;
   for (int32_t v : a) { ids[pos] = v; types[pos] = 0; mask[pos] = 1; ++pos; }

@@ -46,10 +46,18 @@ def _build() -> None:
     srcs = sorted(
         os.path.join(_SRC_DIR, f) for f in os.listdir(_SRC_DIR)
         if f.endswith(".cpp"))
-    cmd = ["g++", "-O3", "-fPIC", "-std=c++17", "-shared", "-pthread",
-           "-o", _LIB_PATH] + srcs
-    subprocess.run(cmd, check=True, capture_output=True, text=True,
-                   timeout=300)
+    # compile to a per-pid temp and atomically rename: concurrent processes
+    # (multi-rank launch, parallel pytest) must never load a half-written .so
+    tmp = f"{_LIB_PATH}.tmp{os.getpid()}"
+    cmd = ["g++", "-O3", "-fPIC", "-std=c++17", "-Wall", "-Wextra",
+           "-shared", "-pthread", "-o", tmp] + srcs
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, text=True,
+                       timeout=300)
+        os.replace(tmp, _LIB_PATH)
+    finally:
+        if os.path.exists(tmp):
+            os.remove(tmp)
 
 
 def _declare(lib: ctypes.CDLL) -> None:

@@ -42,9 +42,11 @@ class PrefetchLoader:
                 f"shard {shard}/{num_shards} of {n} records is empty")
 
         self._fields = []
+        contiguous = {}
         offset = 0
         for k in names:
             a = np.ascontiguousarray(arrays[k])
+            contiguous[k] = a
             item_shape = a.shape[1:]
             nbytes = int(a.dtype.itemsize * np.prod(item_shape, dtype=int))
             self._fields.append((k, a.dtype, item_shape, offset, nbytes))
@@ -57,9 +59,8 @@ class PrefetchLoader:
         # borrows this pointer for the loader's lifetime)
         self._records = np.empty((n, self._item_bytes), np.uint8)
         for k, dtype, item_shape, off, nbytes in self._fields:
-            flat = (np.ascontiguousarray(arrays[k])
-                    .reshape(n, -1).view(np.uint8))
-            self._records[:, off:off + nbytes] = flat
+            self._records[:, off:off + nbytes] = (
+                contiguous.pop(k).reshape(n, -1).view(np.uint8))
         self._out = np.empty((batch_size, self._item_bytes), np.uint8)
 
         u8p = ctypes.POINTER(ctypes.c_uint8)

@@ -1,0 +1,52 @@
+#!/usr/bin/env python
+"""Kill stray training processes on a set of hosts (reference C25:
+BERT/scripts/kill_processes.py — ssh pkill fan-out).
+
+Default target pattern matches this framework's drivers only (never a bare
+``pkill python``: shared hosts run other people's jobs too).
+
+Usage:
+    python scripts/kill_processes.py --workers-file workers.txt
+    python scripts/kill_processes.py            # local host only
+"""
+
+from __future__ import annotations
+
+import argparse
+import subprocess
+import sys
+
+PATTERN = "oktopk_tpu.train"
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--workers-file", default=None)
+    p.add_argument("--pattern", default=PATTERN)
+    p.add_argument("--dry-run", action="store_true")
+    args = p.parse_args(argv)
+
+    hosts = ["localhost"]
+    if args.workers_file:
+        with open(args.workers_file) as f:
+            hosts = [h.strip() for h in f
+                     if h.strip() and not h.startswith("#")]
+    rc = 0
+    for host in hosts:
+        if host in ("localhost", "127.0.0.1"):
+            cmd = ["pkill", "-f", args.pattern]
+        else:
+            cmd = ["ssh", "-o", "StrictHostKeyChecking=no", host,
+                   f"pkill -f {args.pattern}"]
+        if args.dry_run:
+            print(" ".join(cmd))
+            continue
+        r = subprocess.run(cmd).returncode
+        # pkill rc=1 just means "no processes matched"
+        if r not in (0, 1):
+            rc = r
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,183 @@
+#!/usr/bin/env python
+"""Experiment sweep runner (reference C25: BERT/scripts/driver_sweep.py's
+ssh/docker fan-out, VGG/sbatch_vgg_jobs.sh's algorithm sweep).
+
+Runs a compressor x density grid of training jobs and collects one JSON
+result line per run into ``--out``. Three execution modes:
+
+- ``local`` (default): sequential subprocesses on this host, each driving
+  the whole mesh (the TPU-native norm: one process per host, pjit over all
+  chips — no per-GPU rank fan-out needed);
+- ``slurm``: submit one sbatch job per grid point via scripts/*.sh
+  (compressor/density passed by environment, reference
+  VGG/sbatch_vgg_jobs.sh:1-7);
+- ``ssh``: fan out over a workers file (one host per line, reference
+  generate_workers_file.py format) for multi-host jax.distributed jobs.
+
+Examples:
+    python scripts/sweep.py --dnn mnistnet --fake-devices 8 --max-iters 50 \\
+        --compressors oktopk,topkA,dense --densities 0.02 --out sweep.jsonl
+    python scripts/sweep.py --mode slurm --job vgg16_oktopk.sh \\
+        --compressors oktopk,gaussiank
+"""
+
+from __future__ import annotations
+
+import argparse
+import itertools
+import json
+import os
+import re
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser(
+        description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    p.add_argument("--mode", choices=["local", "slurm", "ssh"],
+                   default="local")
+    p.add_argument("--compressors",
+                   default="oktopk,topkA,gaussiank,gtopk,topkDSA,dense")
+    p.add_argument("--densities", default="0.02")
+    p.add_argument("--dnn", default="vgg16")
+    p.add_argument("--dataset", default="cifar10")
+    p.add_argument("--batch-size", type=int, default=16)
+    p.add_argument("--lr", type=float, default=0.1)
+    p.add_argument("--max-iters", type=int, default=100)
+    p.add_argument("--warmup-steps", type=int, default=None)
+    p.add_argument("--fake-devices", type=int, default=0)
+    p.add_argument("--out", default="sweep_results.jsonl")
+    p.add_argument("--job", default="vgg16_oktopk.sh",
+                   help="slurm mode: job script under scripts/")
+    p.add_argument("--workers-file", default=None,
+                   help="ssh mode: one host per line")
+    p.add_argument("--dry-run", action="store_true",
+                   help="print the commands without running them")
+    return p.parse_args(argv)
+
+
+def grid(args):
+    return list(itertools.product(args.compressors.split(","),
+                                  [float(d) for d in
+                                   args.densities.split(",")]))
+
+
+def local_cmd(args, compressor, density):
+    cmd = [sys.executable, "-m", "oktopk_tpu.train.main_trainer",
+           "--dnn", args.dnn, "--dataset", args.dataset,
+           "--batch-size", str(args.batch_size), "--lr", str(args.lr),
+           "--compressor", compressor, "--density", str(density),
+           "--max-iters", str(args.max_iters), "--log-every",
+           str(max(1, args.max_iters // 5))]
+    if args.warmup_steps is not None:
+        cmd += ["--warmup-steps", str(args.warmup_steps)]
+    if args.fake_devices:
+        cmd += ["--fake-devices", str(args.fake_devices)]
+    return cmd
+
+
+LOSS_RE = re.compile(
+    r"epoch done @ iter (\d+): loss ([\d.naninf]+) vol/step (\d+)")
+
+
+def run_local(args):
+    results = []
+    for compressor, density in grid(args):
+        cmd = local_cmd(args, compressor, density)
+        if args.dry_run:
+            print(" ".join(cmd))
+            continue
+        t0 = time.time()
+        proc = subprocess.run(cmd, capture_output=True, text=True, cwd=REPO)
+        dt = time.time() - t0
+        rec = {"compressor": compressor, "density": density,
+               "rc": proc.returncode, "wall_s": round(dt, 1)}
+        last = None
+        for line in (proc.stdout + proc.stderr).splitlines():
+            m = LOSS_RE.search(line)
+            if m:
+                last = m
+        if last:
+            rec.update(iters=int(last.group(1)),
+                       loss=float(last.group(2)),
+                       vol_per_step=int(last.group(3)))
+        else:
+            rec["log_tail"] = (proc.stdout + proc.stderr)[-500:]
+        results.append(rec)
+        print(json.dumps(rec), flush=True)
+    return results
+
+
+def run_slurm(args):
+    results = []
+    for compressor, density in grid(args):
+        cmd = ["sbatch", os.path.join("scripts", args.job)]
+        env = dict(os.environ, compressor=compressor, density=str(density),
+                   dnn=args.dnn)
+        if args.dry_run:
+            print(f"compressor={compressor} density={density} "
+                  + " ".join(cmd))
+            continue
+        proc = subprocess.run(cmd, capture_output=True, text=True,
+                              env=env, cwd=REPO)
+        rec = {"compressor": compressor, "density": density,
+               "rc": proc.returncode,
+               "sbatch": proc.stdout.strip() or proc.stderr.strip()}
+        results.append(rec)
+        print(json.dumps(rec), flush=True)
+    return results
+
+
+def run_ssh(args):
+    """Multi-host fan-out: the same driver command on every host with
+    OKTOPK_* rendezvous env (oktopk_tpu/launch.py discovers it)."""
+    if not args.workers_file:
+        raise SystemExit("--workers-file required for --mode ssh")
+    with open(args.workers_file) as f:
+        hosts = [h.strip() for h in f if h.strip()
+                 and not h.startswith("#")]
+    results = []
+    for compressor, density in grid(args):
+        cmd = local_cmd(args, compressor, density)
+        procs = []
+        for i, host in enumerate(hosts):
+            env = (f"OKTOPK_NUM_PROCS={len(hosts)} OKTOPK_PROC_ID={i} "
+                   f"OKTOPK_COORDINATOR={hosts[0]}")
+            remote = (f"cd {REPO} && {env} " + " ".join(cmd))
+            ssh = ["ssh", "-o", "StrictHostKeyChecking=no", host, remote]
+            if args.dry_run:
+                print(" ".join(ssh))
+                continue
+            procs.append((host, subprocess.Popen(
+                ssh, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+                text=True)))
+        for host, proc in procs:
+            out, _ = proc.communicate()
+            rec = {"compressor": compressor, "density": density,
+                   "host": host, "rc": proc.returncode,
+                   "log_tail": out[-500:]}
+            results.append(rec)
+            print(json.dumps(rec), flush=True)
+    return results
+
+
+def main(argv=None):
+    args = parse_args(argv)
+    runner = {"local": run_local, "slurm": run_slurm, "ssh": run_ssh}
+    results = runner[args.mode](args)
+    if results and not args.dry_run:
+        with open(args.out, "a") as f:
+            for rec in results:
+                f.write(json.dumps(rec) + "\n")
+        print(f"[sweep] {len(results)} results appended to {args.out}",
+              file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -73,6 +73,19 @@ class TestTokenizerParity:
             assert (nat.encode_pair(a, b, max_len)
                     == py.encode_pair(a, b, max_len)), max_len
 
+    def test_whitespace_only_second_text_matches_python(self, both):
+        nat, py = both
+        for tb in ("   ", "\t\n"):
+            assert (nat.encode_pair("the fox", tb, 12)
+                    == py.encode_pair("the fox", tb, 12)), repr(tb)
+
+    def test_fully_truncated_second_segment_matches_python(self, both):
+        nat, py = both
+        # b drains to empty under longest-first truncation at max_len=4:
+        # Python then emits no second [SEP] — native must match
+        assert (nat.encode_pair("fox", "dog", 4)
+                == py.encode_pair("fox", "dog", 4))
+
     def test_long_token_is_unk(self, both):
         nat, py = both
         text = "a" * 150
@@ -99,6 +112,24 @@ class TestPrefetchLoader:
         assert b["image"].shape == (8, 2, 3) and b["image"].dtype == np.uint8
         assert b["label"].shape == (8,) and b["label"].dtype == np.int64
         dl.close()
+
+    def test_drop_last_never_mixes_epochs(self):
+        from oktopk_tpu.native.loader import PrefetchLoader
+
+        n, bs = 20, 8  # 20 % 8 = 4-record tail dropped each epoch
+        dl = PrefetchLoader(self._arrays(n), batch_size=bs, seed=2)
+        for _ in range(10):
+            b = dl.next_batch()["label"].tolist()
+            assert len(set(b)) == bs, f"duplicate records in batch: {b}"
+        dl.close()
+
+    def test_empty_shard_raises(self):
+        from oktopk_tpu.native.loader import PrefetchLoader
+
+        import pytest as _pytest
+        with _pytest.raises(ValueError, match="empty"):
+            PrefetchLoader(self._arrays(3), batch_size=2, seed=0,
+                           shard=3, num_shards=4)
 
     def test_epoch_covers_every_record_once(self):
         from oktopk_tpu.native.loader import PrefetchLoader

@@ -1,0 +1,69 @@
+"""Sweep-runner tests (reference C25 cluster scripts,
+BERT/scripts/driver_sweep.py / kill_processes.py)."""
+
+import json
+import subprocess
+import sys
+import os
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run(args):
+    return subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "sweep.py")] + args,
+        capture_output=True, text=True, cwd=REPO)
+
+
+class TestDryRuns:
+    def test_local_grid_size(self):
+        p = _run(["--dry-run", "--compressors", "a,b",
+                  "--densities", "0.1,0.2"])
+        assert p.returncode == 0
+        lines = [l for l in p.stdout.splitlines() if "main_trainer" in l]
+        assert len(lines) == 4
+
+    def test_slurm_passes_env(self):
+        p = _run(["--dry-run", "--mode", "slurm",
+                  "--compressors", "oktopk", "--densities", "0.05"])
+        assert p.returncode == 0
+        assert "compressor=oktopk density=0.05" in p.stdout
+        assert "sbatch" in p.stdout
+
+    def test_ssh_requires_workers_file(self):
+        p = _run(["--dry-run", "--mode", "ssh"])
+        assert p.returncode != 0
+        assert "workers-file" in p.stderr
+
+    def test_ssh_rendezvous_env(self, tmp_path):
+        wf = tmp_path / "workers.txt"
+        wf.write_text("host-a\nhost-b\n")
+        p = _run(["--dry-run", "--mode", "ssh",
+                  "--workers-file", str(wf), "--compressors", "dense"])
+        assert p.returncode == 0
+        assert "OKTOPK_NUM_PROCS=2" in p.stdout
+        assert "OKTOPK_PROC_ID=1" in p.stdout
+        assert "OKTOPK_COORDINATOR=host-a" in p.stdout
+
+    def test_kill_processes_dry_run(self):
+        p = subprocess.run(
+            [sys.executable,
+             os.path.join(REPO, "scripts", "kill_processes.py"),
+             "--dry-run"], capture_output=True, text=True)
+        assert p.returncode == 0
+        assert "pkill -f oktopk_tpu.train" in p.stdout
+
+
+def test_local_sweep_end_to_end(tmp_path):
+    out = tmp_path / "results.jsonl"
+    p = _run(["--dnn", "mnistnet", "--dataset", "mnist",
+              "--compressors", "dense", "--densities", "0.02",
+              "--fake-devices", "2", "--batch-size", "2",
+              "--max-iters", "3", "--warmup-steps", "1",
+              "--out", str(out)])
+    assert p.returncode == 0, p.stdout + p.stderr
+    recs = [json.loads(l) for l in out.read_text().splitlines()]
+    assert len(recs) == 1
+    assert recs[0]["rc"] == 0
+    assert recs[0]["iters"] == 3
+    assert "loss" in recs[0]
