@@ -52,21 +52,50 @@ def count_ge(x: jnp.ndarray, thresh, use_pallas: bool = False):
     return jnp.sum(partial_counts)
 
 
+_WAYS = 8  # brackets per pass; each memory pass narrows log2(_WAYS) bits
+
+
 def k2threshold_bisect(x_abs: jnp.ndarray, k: int, iters: int = 30,
                        use_pallas: bool = False):
-    """Sort-free k-th-largest estimate: bisection between 0 and max|x| until
-    count(|x| >= t) ~= k. After ``iters`` trips the bracket is max|x|/2^iters
-    wide — far below float32 resolution for 30 trips. Returns the lower edge
-    (count >= k), matching ``k2threshold``'s inclusivity."""
+    """Sort-free k-th-largest estimate to ``iters`` bits of precision.
+
+    Multi-way bisection: each trip splits the bracket [lo, hi) into
+    ``_WAYS`` sub-intervals and counts all boundaries in ONE pass over the
+    data (per-element ``searchsorted`` into the 7 interior cut points +
+    bincount), then keeps the sub-interval where count(|x| >= t) crosses k.
+    One memory pass narrows 3 bits instead of the 1 bit of classic
+    bisection, so 30-bit precision costs 10 passes instead of 30 — the hot
+    selection path is HBM-bandwidth-bound (SURVEY.md §7.3.5).
+
+    Returns the bracket's lower edge (count(>= lo) >= k), matching
+    ``k2threshold``'s inclusivity. The final bracket is max|x|/2^iters wide
+    — below float32 resolution for the default 30.
+    """
     hi0 = jnp.max(x_abs)
+    flat = x_abs.reshape(-1)
+    bits_per_pass = max(1, int(_WAYS).bit_length() - 1)  # log2(_WAYS)
+    passes = -(-iters // bits_per_pass)
 
     def body(_, carry):
         lo, hi = carry
-        mid = 0.5 * (lo + hi)
-        c = count_ge(x_abs, mid, use_pallas=use_pallas)
-        # keep count(>= lo) >= k invariant: converge onto the k-th value
-        enough = c >= k
-        return jnp.where(enough, mid, lo), jnp.where(enough, hi, mid)
+        # interior cut points t_1 < ... < t_{W-1} of [lo, hi)
+        frac = jnp.arange(1, _WAYS, dtype=x_abs.dtype) / _WAYS
+        cuts = lo + (hi - lo) * frac
+        # ONE data pass: per-element bucket id (3 register compares via
+        # searchsorted), then counts[j] = #elements above cut j as a fused
+        # streaming reduce — no scatter, nothing materialised at [n, W]
+        b = jnp.searchsorted(cuts, flat, side="left").astype(jnp.int32)
+        counts = jnp.sum(
+            b[:, None] >= jnp.arange(_WAYS, dtype=jnp.int32)[None, :],
+            axis=0)
+        # counts[0] = n (>= k always); counts[j>=1] = #{x > cuts[j-1]}.
+        # Keep the bracket whose lower edge still has >= k above it.
+        enough = counts >= k
+        j = jnp.max(jnp.where(enough, jnp.arange(_WAYS), 0))
+        edges = jnp.concatenate([lo[None], cuts, hi[None]])
+        return edges[j], edges[j + 1]
 
-    lo, hi = lax.fori_loop(0, iters, body, (jnp.zeros_like(hi0), hi0))
+    lo, hi = lax.fori_loop(
+        0, passes, body,
+        (jnp.zeros_like(hi0), hi0 * (1 + 1e-6) + 1e-30))
     return lo
