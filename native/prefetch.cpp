@@ -135,11 +135,14 @@ void* okn_loader_new(const uint8_t* data, int64_t n_items, int64_t item_bytes,
 
 // Blocks until a prefetched batch is ready; copies it into out
 // ([batch, item_bytes]) and returns the record count (< batch only for a
-// partial final batch with drop_last=0).
+// partial final batch with drop_last=0, or 0 when the loader is stopping —
+// without the stop check here, okn_loader_free racing a blocked next()
+// would join a worker that already exited and deadlock the waiter).
 int64_t okn_loader_next(void* h, uint8_t* out) {
   auto* l = static_cast<Loader*>(h);
   std::unique_lock<std::mutex> lk(l->mu);
-  l->cv_full.wait(lk, [&] { return l->filled > 0; });
+  l->cv_full.wait(lk, [&] { return l->stop.load() || l->filled > 0; });
+  if (l->filled == 0) return 0;  // stopping, nothing buffered
   size_t slot = l->head;
   int64_t count = l->ring_count[slot];
   std::memcpy(out, l->ring[slot].data(),
@@ -150,13 +153,26 @@ int64_t okn_loader_next(void* h, uint8_t* out) {
   return count;
 }
 
-void okn_loader_free(void* h) {
+// Wake the worker and any thread blocked in okn_loader_next (they return 0
+// once the ring drains). Does NOT release the Loader: the caller must keep
+// the handle alive until every in-flight okn_loader_next has returned, then
+// call okn_loader_free — the Python wrapper tracks in-flight calls under
+// its own lock, which is what makes free-vs-blocked-next safe (a C-side
+// "wait for waiters" handshake can't see a caller that is between reading
+// the handle and entering the call).
+void okn_loader_stop(void* h) {
   auto* l = static_cast<Loader*>(h);
   {
     std::lock_guard<std::mutex> lk(l->mu);
     l->stop.store(true);
   }
   l->cv_empty.notify_all();
+  l->cv_full.notify_all();
+}
+
+void okn_loader_free(void* h) {
+  auto* l = static_cast<Loader*>(h);
+  okn_loader_stop(h);
   if (l->worker.joinable()) l->worker.join();
   delete l;
 }

@@ -155,6 +155,11 @@ bool is_punct(uint32_t cp) {
   if ((cp >= 33 && cp <= 47) || (cp >= 58 && cp <= 64)
       || (cp >= 91 && cp <= 96) || (cp >= 123 && cp <= 126))
     return true;
+  // ... plus Latin-1 supplement category-P code points (¡ § « ¶ · » ¿ —
+  // the other A1-BF signs are category S, not punctuation in Python either)
+  if (cp == 0xA1 || cp == 0xA7 || cp == 0xAB || cp == 0xB6 || cp == 0xB7
+      || cp == 0xBB || cp == 0xBF)
+    return true;
   // ... plus General Punctuation and CJK punctuation (category P)
   return (cp >= 0x2010 && cp <= 0x2027) || (cp >= 0x2030 && cp <= 0x205E)
          || (cp >= 0x3001 && cp <= 0x3011) || (cp >= 0xFF01 && cp <= 0xFF0F);

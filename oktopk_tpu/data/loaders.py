@@ -27,18 +27,18 @@ CIFAR_STD = np.array([0.2023, 0.1994, 0.2010], np.float32)
 
 def _batched(x: Dict[str, np.ndarray], batch_size: int, seed: int,
              shuffle: bool = True) -> Iterator[Dict[str, np.ndarray]]:
-    """Shuffled epoch batches. Training iterators prefer the native
+    """Shuffled epoch batches. Training iterators use the native
     prefetching loader (C++ background thread, oktopk_tpu/native/loader.py
-    — the torch-DataLoader-worker replacement); falls back to the Python
-    batcher when the toolchain is absent."""
+    — the torch-DataLoader-worker replacement) when the OKTOPK_NATIVE
+    policy resolves to it (see oktopk_tpu.native.resolve: explicit opt-in
+    for multi-process runs, never a silent per-host fallback)."""
     if shuffle:
-        try:
+        from oktopk_tpu import native
+        if native.resolve("loader"):
             from oktopk_tpu.native.loader import make_prefetch_iter
             it = make_prefetch_iter(x, batch_size, seed=seed)
             if it is not None:
                 return it
-        except Exception:
-            pass
 
     def gen():
         n = len(next(iter(x.values())))
@@ -115,13 +115,12 @@ def make_dataset(dataset: str, dnn: str, batch_size: int,
             vocab_file = os.path.join(path, "vocab.txt")
             tok = None
             if os.path.exists(vocab_file):
-                try:  # native WordPiece (C++) when the toolchain allows
+                from oktopk_tpu import native
+                if native.resolve("tokenizer"):
                     from oktopk_tpu.native.tokenizer import NativeTokenizer
                     nat = NativeTokenizer(vocab_file)
                     if nat.native:
                         tok = nat
-                except Exception:
-                    pass
             if tok is None:
                 tok = FullTokenizer(
                     vocab_file if os.path.exists(vocab_file) else None)

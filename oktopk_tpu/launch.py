@@ -160,4 +160,6 @@ def maybe_initialize(env: Optional[dict] = None, port: int = DEFAULT_PORT,
         kwargs["coordinator_address"] = penv.coordinator
     jax.distributed.initialize(**kwargs)
     _initialized = True
+    from oktopk_tpu import native
+    native.check_multiprocess_consistency()
     return penv

@@ -80,6 +80,7 @@ def _declare(lib: ctypes.CDLL) -> None:
                                    i64, i64, i64, ctypes.c_int]
     lib.okn_loader_next.restype = i64
     lib.okn_loader_next.argtypes = [ctypes.c_void_p, u8p]
+    lib.okn_loader_stop.argtypes = [ctypes.c_void_p]
     lib.okn_loader_free.argtypes = [ctypes.c_void_p]
 
 
@@ -110,3 +111,99 @@ def available() -> bool:
 def build_error() -> str | None:
     load()
     return _build_error
+
+
+_resolved: dict = {}
+
+_OFF_MODES = ("0", "off", "no", "false")
+_REQUIRE_MODES = ("1", "require", "on", "true")
+
+
+def _multi_process() -> bool:
+    """True when this is one process of a multi-host run. Probes the
+    jax.distributed global state directly — NOT jax.process_count(), which
+    initialises a backend (here that would lock in the axon TPU plugin
+    before the caller can force a platform). Not initialised ⇒ treated as
+    single-process; launch.maybe_initialize() re-checks consistency after
+    rendezvous (check_multiprocess_consistency)."""
+    import sys
+    if sys.modules.get("jax") is None:
+        return False
+    try:
+        from jax._src import distributed
+        n = getattr(distributed.global_state, "num_processes", None)
+        return n is not None and n > 1
+    except Exception:
+        return False
+
+
+def resolve(component: str) -> bool:
+    """Whether ``component`` ("loader", "tokenizer") should use the native
+    path. Policy via OKTOPK_NATIVE:
+
+    - ``1``/``require``/``on`` — native required; raises if the toolchain is
+      missing (so a multi-host run fails loudly instead of diverging);
+    - ``0``/``off``/``no`` (or legacy OKTOPK_NO_NATIVE=1) — pure Python;
+    - unset/``auto`` — native when available in *single-process* runs only.
+
+    In multi-process runs ``auto`` resolves to the Python path: the native
+    shuffle (splitmix64 Fisher-Yates) and tokenizer are each deterministic
+    but differ from their Python counterparts, so a per-host build failure
+    under a silent try/except would feed hosts different data into the same
+    sharded step with no error (advisor finding r1). The choice must be a
+    global config decision, not per-host toolchain luck.
+    """
+    mode = os.environ.get("OKTOPK_NATIVE", "auto").strip().lower()
+    if os.environ.get("OKTOPK_NO_NATIVE") == "1":
+        mode = "0"
+    key = (component, mode, _multi_process())
+    if key in _resolved:
+        return _resolved[key]
+    import logging
+    log = logging.getLogger("oktopk_tpu.native")
+    if mode in _OFF_MODES:
+        use = False
+        log.info("native %s: disabled (OKTOPK_NATIVE=%s)", component, mode)
+    elif mode in _REQUIRE_MODES:
+        if load() is None:
+            raise RuntimeError(
+                f"OKTOPK_NATIVE={mode} but the native library is "
+                f"unavailable for {component}: {build_error()}")
+        use = True
+        log.info("native %s: enabled (required)", component)
+    else:  # auto
+        if _multi_process():
+            use = False
+            log.info("native %s: off in multi-process run under auto "
+                     "policy (set OKTOPK_NATIVE=1 to force it everywhere)",
+                     component)
+        else:
+            use = load() is not None
+            log.info("native %s: %s (auto%s)", component,
+                     "enabled" if use else "unavailable, python fallback",
+                     "" if use else f"; {build_error()}")
+    _resolved[key] = use
+    return use
+
+
+def check_multiprocess_consistency() -> None:
+    """Called by launch.maybe_initialize() right after
+    jax.distributed.initialize. If a component already resolved to the
+    native path under the 'auto' policy while this process looked
+    single-process (data pipeline built before rendezvous), the choice was
+    per-host toolchain luck after all — refuse to continue rather than let
+    hosts silently shuffle/tokenize differently (advisor finding r1)."""
+    if not _multi_process():
+        return
+    # ANY pre-rendezvous auto resolution is unverifiable cross-host — a host
+    # that resolved to python (toolchain failure) is just as divergent as one
+    # that resolved to native, and must error here rather than hang in the
+    # first collective while its peer raises.
+    tainted = [comp for (comp, mode, multi), use in _resolved.items()
+               if mode not in _OFF_MODES + _REQUIRE_MODES and not multi]
+    if tainted:
+        raise RuntimeError(
+            "native components %s were auto-resolved before "
+            "jax.distributed.initialize; in multi-host runs set "
+            "OKTOPK_NATIVE=1 (require everywhere) or OKTOPK_NATIVE=0 "
+            "(disable everywhere) explicitly" % sorted(set(tainted)))

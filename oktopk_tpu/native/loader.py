@@ -10,6 +10,7 @@ the device step without touching the GIL."""
 from __future__ import annotations
 
 import ctypes
+import threading
 from typing import Dict, Iterator, Optional
 
 import numpy as np
@@ -68,11 +69,29 @@ class PrefetchLoader:
             self._records.ctypes.data_as(u8p), n, self._item_bytes,
             batch_size, seed, shard, num_shards, prefetch_depth,
             1 if drop_last else 0)
+        # close() must not free the C loader while another thread is inside
+        # okn_loader_next (or between reading the handle and entering it):
+        # in-flight calls are counted under _mu and close() drains them
+        # after okn_loader_stop wakes any blocked waiter.
+        self._mu = threading.Condition()
+        self._inflight = 0
 
     def next_batch(self) -> Dict[str, np.ndarray]:
         u8p = ctypes.POINTER(ctypes.c_uint8)
-        count = self._lib.okn_loader_next(
-            self._handle, self._out.ctypes.data_as(u8p))
+        with self._mu:
+            handle = self._handle
+            if handle is None:
+                count = 0
+            else:
+                self._inflight += 1
+        if handle is not None:
+            try:
+                count = self._lib.okn_loader_next(
+                    handle, self._out.ctypes.data_as(u8p))
+            finally:
+                with self._mu:
+                    self._inflight -= 1
+                    self._mu.notify_all()
         batch = self._out[:count]
         out = {}
         for k, dtype, item_shape, off, nbytes in self._fields:
@@ -88,9 +107,17 @@ class PrefetchLoader:
             yield self.next_batch()
 
     def close(self) -> None:
-        if getattr(self, "_handle", None) is not None:
-            self._lib.okn_loader_free(self._handle)
-            self._handle = None
+        if getattr(self, "_handle", None) is None:
+            return
+        with self._mu:
+            handle, self._handle = self._handle, None
+            if handle is None:
+                return
+        self._lib.okn_loader_stop(handle)  # wake blocked next_batch calls
+        with self._mu:
+            while self._inflight:
+                self._mu.wait()
+        self._lib.okn_loader_free(handle)
 
     def __del__(self):
         try:

@@ -243,8 +243,10 @@ class Trainer:
                     flush_pending()
                 if logger:
                     dt = (time.time() - t0) / log_every
+                    # absolute step, not the loop index: after a preemption
+                    # resume the log must agree with scalars.csv/checkpoints
                     logger.info(
-                        "iter %d loss %.4f vol %.0f %.3fs/it", i + 1,
+                        "iter %d loss %.4f vol %.0f %.3fs/it", step,
                         float(metrics["loss"]),
                         float(metrics["comm_volume"]), dt)
                     t0 = time.time()

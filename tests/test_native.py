@@ -49,6 +49,8 @@ PARITY_TEXTS = [
     "naïve Über",               # naïve Über: accent strip + lower
     "résumé",                   # é -> e (decomposable)
     "Łukasz",                        # Ł has no NFD decomposition
+    "«hello»",                  # Latin-1 supplement punctuation splits
+    "¿hello? ¡world! §2 the·dog ¶",  # all seven A1-BF category-P points
 ]
 
 
@@ -203,3 +205,32 @@ class TestPrefetchLoader:
         for _ in range(200):
             dl.next_batch()
         dl.close()
+
+    def test_close_while_blocked_in_next(self):
+        """okn_loader_free racing a thread blocked in okn_loader_next must
+        wake that thread (returning a short batch), not deadlock it — the
+        wait predicate has to include the stop flag."""
+        import threading
+        import time
+
+        from oktopk_tpu.native.loader import PrefetchLoader
+
+        # large records: each ring refill is a multi-ms memcpy, so the
+        # consumer's second call reliably blocks on the depth-1 ring
+        n = 4
+        arrays = {"x": np.zeros((n, 8 << 20), np.uint8)}
+        dl = PrefetchLoader(arrays, batch_size=2, seed=0, prefetch_depth=1)
+        got_first = threading.Event()
+
+        def consume():  # exactly two calls — no touching dl after close()
+            dl.next_batch()
+            got_first.set()
+            dl.next_batch()
+
+        t = threading.Thread(target=consume, daemon=True)
+        t.start()
+        assert got_first.wait(30)
+        time.sleep(0.002)  # let the consumer enter its second next_batch()
+        dl.close()
+        t.join(timeout=30)
+        assert not t.is_alive(), "next() deadlocked against close()"
