@@ -121,10 +121,13 @@ def make_dataset(dataset: str, dnn: str, batch_size: int,
                     nat = NativeTokenizer(vocab_file)
                     if nat.native:
                         tok = nat
-            if tok is None:
-                tok = FullTokenizer(
-                    vocab_file if os.path.exists(vocab_file) else None)
             vocab_size = 1024 if dnn == "bert_tiny" else 30522
+            if tok is None:
+                # hash fallback must emit ids inside the model's embedding
+                # table (OOB ids NaN silently on XLA)
+                tok = FullTokenizer(
+                    vocab_file if os.path.exists(vocab_file) else None,
+                    fallback_size=vocab_size)
             seq = 32 if dnn == "bert_tiny" else 128
             return (pretrain_iterator(corpus, tok, batch_size, seq,
                                       seed, vocab_size),

@@ -100,6 +100,12 @@ class FullTokenizer:
         self.basic = BasicTokenizer(do_lower_case)
         self.wordpiece = WordpieceTokenizer(self.vocab)
 
+    @property
+    def vocab_size(self) -> int:
+        """Id-space size: every emitted id is < vocab_size (model embedding
+        tables must be at least this big — OOB ids NaN silently on XLA)."""
+        return self.fallback_size if self.hash_fallback else len(self.vocab)
+
     def tokenize(self, text: str) -> List[str]:
         if self.hash_fallback:
             return self.basic.tokenize(text)

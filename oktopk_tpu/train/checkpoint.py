@@ -88,6 +88,58 @@ def _merge_missing(template, loaded, path="", defaulted=None, dropped=None):
     return loaded
 
 
+def load_encoder_params(ckpt_dir_or_file: str, params: Any,
+                        subtree: str = "bert",
+                        prefix: str = "ckpt") -> Any:
+    """Warm-start fine-tuning: graft a pretrained encoder subtree into
+    freshly initialised params, leaving the task head untouched.
+
+    The reference's GLUE driver loads only the ``bert.*`` weights of a
+    pretraining checkpoint into the classification model
+    (BERT/bert/compute_glue_scores.py); here the pretraining checkpoint is a
+    full DistTrainState msgpack (``save_checkpoint``) and both
+    ``BertForPreTraining`` and ``BertForSequenceClassification`` carry the
+    encoder under ``params[subtree]``, so the graft is a single subtree
+    restore against the fine-tune template. Every leaf is shape-checked
+    against the template (flax's ``from_state_dict`` accepts wrong-shaped
+    leaves silently; a bert_large checkpoint grafted into a bert_base model
+    must fail here, at the ``--ckpt`` flag, not steps later inside XLA).
+    """
+    path = ckpt_dir_or_file
+    if os.path.isdir(path):
+        path = latest_checkpoint(path, prefix)
+        if path is None:
+            raise FileNotFoundError(f"no checkpoints in {ckpt_dir_or_file}")
+    with open(path, "rb") as f:
+        raw = flax.serialization.msgpack_restore(f.read())
+    loaded = raw.get("state", raw)
+    loaded = loaded.get("params", loaded)
+    if subtree not in loaded:
+        raise KeyError(
+            f"checkpoint {path} has no '{subtree}' params subtree "
+            f"(top-level keys: {sorted(loaded)[:8]})")
+    if subtree not in params:
+        raise KeyError(f"model params have no '{subtree}' subtree")
+    encoder = flax.serialization.from_state_dict(
+        params[subtree], loaded[subtree])
+    mismatches = []
+    for (path_t, t), (_, l) in zip(
+            jax.tree_util.tree_leaves_with_path(params[subtree]),
+            jax.tree_util.tree_leaves_with_path(encoder)):
+        if tuple(np.shape(t)) != tuple(np.shape(l)):
+            mismatches.append(
+                f"{jax.tree_util.keystr(path_t)}: template "
+                f"{tuple(np.shape(t))} vs checkpoint {tuple(np.shape(l))}")
+    if mismatches:
+        raise ValueError(
+            f"checkpoint {path} encoder shapes do not match the model "
+            f"(wrong --model for this checkpoint?): " + "; ".join(
+                mismatches[:6]))
+    out = dict(params)
+    out[subtree] = encoder
+    return out
+
+
 def restore_checkpoint(ckpt_dir_or_file: str, state_template: Any,
                        prefix: str = "ckpt") -> Tuple[Any, int]:
     """Restore into the template's pytree structure; returns (state, step).

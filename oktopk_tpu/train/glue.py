@@ -181,17 +181,25 @@ def main(argv=None):
                      args.data_dir)
         return 1
 
-    tokenizer = FullTokenizer(args.vocab_file)
+    num_labels = 1 if task.labels is None else len(task.labels)
+    cfg = {"bert_base": BertConfig.base, "bert_large": BertConfig.large,
+           "bert_tiny": BertConfig.tiny}[args.model]()
+    # Token ids must fit the embedding table: the hash-fallback tokenizer is
+    # sized to the model's vocab; a real vocab file dictates the size instead
+    # (and must then match the pretraining checkpoint's table — the
+    # warm-start shape check enforces that).
+    tokenizer = FullTokenizer(args.vocab_file, fallback_size=cfg.vocab_size)
+    if tokenizer.vocab_size != cfg.vocab_size:
+        import dataclasses
+        logger.info("vocab file has %d entries; resizing model vocab from %d",
+                    tokenizer.vocab_size, cfg.vocab_size)
+        cfg = dataclasses.replace(cfg, vocab_size=tokenizer.vocab_size)
     train = featurize(read_examples(task, args.data_dir, "train"),
                       tokenizer, args.max_seq_length, task.labels is None)
     dev = featurize(read_examples(task, args.data_dir, "dev"),
                     tokenizer, args.max_seq_length, task.labels is None)
     logger.info("%s: %d train / %d dev", args.task,
                 len(train["label"]), len(dev["label"]))
-
-    num_labels = 1 if task.labels is None else len(task.labels)
-    cfg = {"bert_base": BertConfig.base, "bert_large": BertConfig.large,
-           "bert_tiny": BertConfig.tiny}[args.model]()
     model = BertForSequenceClassification(cfg, num_labels=num_labels)
     rng = jax.random.PRNGKey(0)
     ex = jnp.zeros((2, args.max_seq_length), jnp.int32)
@@ -199,10 +207,11 @@ def main(argv=None):
                         jnp.ones_like(ex), train=False)["params"]
 
     if args.ckpt:
-        from oktopk_tpu.train.checkpoint import restore_checkpoint
+        from oktopk_tpu.train.checkpoint import load_encoder_params
         # warm-start the encoder from a pretraining checkpoint; heads stay
         # freshly initialised (reference loads bert.* weights only)
-        logger.info("warm-start from %s (encoder subtree)", args.ckpt)
+        params = load_encoder_params(args.ckpt, params)
+        logger.info("warm-started encoder subtree from %s", args.ckpt)
 
     steps_per_epoch = max(1, len(train["label"]) // args.batch_size)
     opt = bert_adam(lr=args.lr, warmup=0.1,
