@@ -1,7 +1,7 @@
 """Benchmark entry point.
 
 Prints ONE JSON line:
-    {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+    {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, ...}
 
 Headline metric: Ok-Topk sparse-allreduce communication volume per worker per
 step (bytes), measured on a multi-worker mesh in the threshold-tracking
@@ -10,23 +10,34 @@ BASELINE.md "allreduce bytes/step vs dense" north star). ``vs_baseline`` is
 the reduction factor (dense bytes / oktopk bytes; higher is better; the
 paper's property is volume < 6k elements, reference README.md:2).
 
-Also measures (stderr, informational): the end-to-end VGG-16/CIFAR-10
-oktopk train-step time on the available accelerator.
+The JSON line also carries the end-to-end numbers the volume claim has to be
+anchored against (VERDICT r2 #2): VGG-16/CIFAR-10 train-step time with the
+oktopk compressor and with dense psum on the available accelerator, their
+variance, and the achieved MFU (XLA cost-analysis flops / step time / peak).
 
 The volume measurement runs in a subprocess on a virtual 8-worker CPU mesh
 (collectives need multiple devices; the benchmark chip is single-device), the
 step-time measurement runs on the real accelerator in-process.
+
+Timing note: through the remote-device tunnel ``block_until_ready`` can
+return before execution finishes; every timed region here ends with a host
+fetch of the loss scalar, which is the only honest synchronization point.
 """
 
 from __future__ import annotations
 
 import json
 import os
+import statistics
 import subprocess
 import sys
 import time
 
 BYTES_PER_ELEM = 4  # f32 scalars; indices are int32
+
+# fp32 peak of one TPU v5e MXU chip; used only for the informational MFU
+# figure. Override with OKTOPK_PEAK_FLOPS for other chips.
+DEFAULT_PEAK_FLOPS = 197e12 / 2
 
 
 def volume_probe():
@@ -53,7 +64,7 @@ def volume_probe():
     rng = np.random.RandomState(0)
     base = rng.randn(P, n).astype(np.float32)
     vols = []
-    for i in range(9):
+    for i in range(13):
         grads = jnp.asarray(base + 0.3 * rng.randn(P, n).astype(np.float32))
         _, state = step(grads, state)
         if i % 4 != 0:   # steady-state predicted steps
@@ -63,9 +74,21 @@ def volume_probe():
     print("VOLUME_PROBE " + json.dumps(out))
 
 
-def step_time_probe():
-    """VGG-16/CIFAR oktopk train-step time on the available accelerator
-    (single-chip mesh: measures the compute+selection path)."""
+def _time_steps(trainer, batch, iters):
+    """Per-step wall times (s), each honestly synced via a loss fetch."""
+    import numpy as np
+    times = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        m = trainer.train_step(batch)
+        float(np.asarray(m["loss"]))
+        times.append(time.perf_counter() - t0)
+    return times
+
+
+def step_time_probe(iters=10):
+    """VGG-16/CIFAR oktopk vs dense train-step time + MFU on the available
+    accelerator (single-chip mesh: measures the compute+selection path)."""
     import jax
     import numpy as np
 
@@ -73,27 +96,52 @@ def step_time_probe():
     from oktopk_tpu.config import TrainConfig
     from oktopk_tpu.data.synthetic import synthetic_batch
     from oktopk_tpu.train.trainer import Trainer
+    from oktopk_tpu.utils.flops import model_complexity
 
     dev = jax.devices()[0]
     mesh = get_mesh((1,), ("data",), devices=[dev])
-    cfg = TrainConfig(dnn="vgg16", dataset="cifar10", batch_size=16,
-                      lr=0.1, compressor="oktopk", density=0.02,
-                      num_workers=1)
-    trainer = Trainer(cfg, mesh=mesh, warmup=False)
     rng = np.random.RandomState(0)
-    batch = synthetic_batch("vgg16", 16, rng)
-    m = trainer.train_step(batch)          # compile
-    jax.block_until_ready(m["loss"])
-    t0 = time.time()
-    iters = 20
-    for _ in range(iters):
-        m = trainer.train_step(batch)
-    jax.block_until_ready(m["loss"])
-    dt = (time.time() - t0) / iters
-    print(f"[bench] device={dev.platform} vgg16 oktopk step "
-          f"{dt * 1e3:.1f} ms  ({16 / dt:.1f} images/s/chip)",
-          file=sys.stderr)
-    return dt
+    # place the batch once: the tunnel's host->device path is not part of
+    # the step (real runs use the prefetching loader)
+    batch = jax.device_put(synthetic_batch("vgg16", 16, rng))
+
+    out = {"device": dev.platform}
+    flops_per_step = None
+    for comp in ("dense", "oktopk"):
+        cfg = TrainConfig(dnn="vgg16", dataset="cifar10", batch_size=16,
+                          lr=0.1, compressor=comp, density=0.02,
+                          num_workers=1)
+        trainer = Trainer(cfg, mesh=mesh, warmup=False)
+        _ = _time_steps(trainer, batch, 2)        # compile + warm
+        times = _time_steps(trainer, batch, iters)
+        ms = [t * 1e3 for t in times]
+        out[f"{comp}_ms"] = statistics.median(ms)
+        out[f"{comp}_ms_std"] = statistics.pstdev(ms)
+        if comp == "dense":
+            try:
+                rng_key = jax.random.PRNGKey(0)
+                cost = model_complexity(
+                    lambda s, b, r: trainer.step_fn(s, b, r),
+                    trainer.state, batch, rng_key)
+                if cost["flops"] > 0:
+                    flops_per_step = cost["flops"]
+            except Exception as e:
+                print(f"[bench] cost analysis unavailable: {e!r}",
+                      file=sys.stderr)
+    if flops_per_step:
+        out["flops_per_step"] = flops_per_step
+        # MFU only against the known TPU peak; on a CPU fallback the ratio
+        # would be meaningless in the machine-readable record (the tunnelled
+        # chip reports platform "axon", a real TPU v5e)
+        if dev.platform != "cpu" or "OKTOPK_PEAK_FLOPS" in os.environ:
+            peak = float(os.environ.get("OKTOPK_PEAK_FLOPS",
+                                        DEFAULT_PEAK_FLOPS))
+            out["peak_flops_assumed"] = peak   # v5e fp32 unless overridden
+            out["mfu_dense"] = flops_per_step / (out["dense_ms"] / 1e3) / peak
+            out["mfu_oktopk"] = (flops_per_step / (out["oktopk_ms"] / 1e3)
+                                 / peak)
+    print(f"[bench] {out}", file=sys.stderr)
+    return out
 
 
 def main():
@@ -116,19 +164,34 @@ def main():
         print(proc.stderr[-4000:], file=sys.stderr)
         raise RuntimeError("volume probe failed")
 
-    try:
-        step_time_probe()
-    except Exception as e:  # informational only — never break the headline
-        print(f"[bench] step-time probe skipped: {e!r}", file=sys.stderr)
+    # step-time probe with a bounded retry: first contact with the real
+    # accelerator through the tunnel occasionally times out
+    steps = {}
+    for attempt in range(2):
+        try:
+            steps = step_time_probe()
+            break
+        except Exception as e:
+            print(f"[bench] step-time probe attempt {attempt} failed: {e!r}",
+                  file=sys.stderr)
+            if attempt == 0:
+                time.sleep(20)
 
     value = probe["mean_volume_elems"] * BYTES_PER_ELEM
     dense = probe["dense_volume_elems"] * BYTES_PER_ELEM
-    print(json.dumps({
+    record = {
         "metric": "oktopk_sparse_allreduce_volume_bytes_per_step",
         "value": round(value, 1),
         "unit": "bytes/step/worker",
         "vs_baseline": round(dense / value, 2),
-    }))
+    }
+    for key in ("device", "oktopk_ms", "oktopk_ms_std", "dense_ms",
+                "dense_ms_std", "flops_per_step", "peak_flops_assumed",
+                "mfu_dense", "mfu_oktopk"):
+        if key in steps:
+            record[key] = (round(steps[key], 3)
+                           if isinstance(steps[key], float) else steps[key])
+    print(json.dumps(record))
 
 
 if __name__ == "__main__":

@@ -23,6 +23,18 @@ class SparseState:
     step: jnp.ndarray                 # i32 — allreduce counter
     local_threshold: jnp.ndarray      # f32 — predicted local sel. threshold
     global_threshold: jnp.ndarray     # f32 — predicted global sel. threshold
+    # Estimated per-step multiplicative growth of the selection threshold,
+    # measured between consecutive exact local recomputes (collectives/
+    # oktopk.py). Under error feedback at low density the unselected mass
+    # grows every step, so thresholds must ride that drift between
+    # recomputes — the reference's fixed +-1.2% band nudges
+    # (VGG/allreducer.py:696-699) cannot track it.
+    drift: jnp.ndarray                # f32 — ~1.0
+    # The threshold measured at the last *exact* local recompute — the
+    # clean baseline for the next drift measurement (the running predicted
+    # threshold is polluted by the controller's own corrections).
+    last_exact_lt: jnp.ndarray        # f32
+
     boundaries: jnp.ndarray           # i32[P+1] — region offsets, [0..n]
     residual: jnp.ndarray             # f32[n] — error-feedback buffer
     # Analytic comm-volume accounting (elements sent by this worker):
@@ -48,6 +60,8 @@ def init_state(cfg: OkTopkConfig, dtype=jnp.float32) -> SparseState:
         step=jnp.asarray(0, jnp.int32),
         local_threshold=jnp.asarray(0.0, dtype),
         global_threshold=jnp.asarray(0.0, dtype),
+        drift=jnp.asarray(1.0, dtype),
+        last_exact_lt=jnp.asarray(0.0, dtype),
         boundaries=boundaries,
         residual=jnp.zeros((n,), dtype),
         volume_elems=jnp.asarray(0.0, jnp.float32),

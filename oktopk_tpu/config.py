@@ -37,16 +37,47 @@ class OkTopkConfig:
     # Dense warmup (reference VGG/allreducer.py:573 = 512; LSTM 128; BERT 0).
     warmup_steps: int = 512
 
-    # Multiplicative threshold adaptation (reference VGG/allreducer.py:209-211
-    # uses 1.012/1.008; BERT/bert/allreducer.py:188-190 uses 1.025/1.036).
+    # Multiplicative threshold adaptation for the baseline algorithms
+    # (reference VGG/allreducer.py:209-211 uses 1.012/1.008;
+    # BERT/bert/allreducer.py:188-190 uses 1.025/1.036).
     local_adapt_scale: float = 1.012
     global_adapt_scale: float = 1.008
+
+    # Ok-Topk threshold controller (collectives/oktopk.py::_newton_adapt):
+    # one Newton step on the measured log-count/log-threshold slope,
+    # sampled with a second count at thresh*probe_ratio (fused into the
+    # same data pass). Replaces the reference's fixed +-1.2% nudge, which
+    # cannot re-enter the band within a recompute window under threshold
+    # drift. newton_exp_* bound the step exponent (-1/slope); per-step
+    # correction is clamped to adapt_max_step.
+    # Half Newton steps + a 1.5x/step clamp: underdamped full steps
+    # resonate with real training dynamics (gradient scale itself moves
+    # with the updates the collective delivers).
+    probe_ratio: float = 1.25
+    newton_exp_lo: float = 0.03
+    newton_exp_hi: float = 0.5
+    adapt_max_step: float = 1.5
+    # Per-step threshold drift estimate (SparseState.drift): clip range for
+    # the measured rate and the EMA mixing factor across recompute windows.
+    drift_clip_lo: float = 0.5
+    drift_clip_hi: float = 2.0
+    # 1.0 = adopt each window's measured rate outright; the damped Newton
+    # controller absorbs measurement noise, and a lagging drift estimate
+    # costs more than a noisy one (it decays into systematic under/over-
+    # selection for the whole next window).
+    drift_ema: float = 1.0
 
     # Control band for the per-step selected count, as multiples of k
     # (reference grows/shrinks the threshold toward [2k/3, 5k/4],
     # VGG/allreducer.py:696-699).
     band_lo: float = 2.0 / 3.0
     band_hi: float = 5.0 / 4.0
+    # Global-count band ceiling. The volume identity is
+    #   vol ~ 4k(P-1)/P + 2*E[global_count]
+    # so with E at the reference's 5k/4 ceiling the total sits exactly ON
+    # the 6k budget; capping the global dead zone at 1.0*k targets ~5.7k
+    # with margin. Local selection keeps the full reference band.
+    band_hi_global: float = 1.0
 
     # Fixed-capacity factors. XLA has no ragged collectives (no Allgatherv /
     # size Alltoall), so every variable-length exchange in the reference
@@ -56,6 +87,12 @@ class OkTopkConfig:
     # modest headroom factor suffices (SURVEY.md §7.3.1).
     cap_pair_factor: float = 2.0    # per (src -> dst-region) buffer, of k/P
     cap_gather_factor: float = 2.5  # per-region allgather buffer, of k/P
+    # Exact-recompute candidate pool per region, of k/P. Load-balanced
+    # regions hold ~k/P of the global top-k each (that balance is what makes
+    # the paper's volume O(k) instead of O(kP)); 4x headroom covers drift
+    # between repartitions. The reference instead gathers ALL nonzeros of
+    # the reduced region (VGG/allreducer.py:819) — unbounded on the wire.
+    cap_exact_factor: float = 4.0
 
     # Gaussian threshold estimation (reference compression.py:238-259 refines a
     # scipy ppf estimate in a bounded loop; we binary-search, see ops/gaussian).
@@ -94,6 +131,12 @@ class OkTopkConfig:
     def cap_gather(self) -> int:
         """Capacity of each per-region allgather buffer (phase b)."""
         cap = int(self.cap_gather_factor * self.k / max(1, self.num_workers)) + 8
+        return min(self.n, cap)
+
+    @property
+    def cap_exact(self) -> int:
+        """Per-region candidate pool for the exact global recompute."""
+        cap = int(self.cap_exact_factor * self.k / max(1, self.num_workers)) + 8
         return min(self.n, cap)
 
     @property

@@ -9,6 +9,7 @@ from __future__ import annotations
 
 import os
 import unicodedata
+import zlib
 from typing import Dict, List, Optional
 
 
@@ -118,8 +119,12 @@ class FullTokenizer:
         if self.hash_fallback:
             n = self.fallback_size
             ns = len(self.SPECIALS)
+            # crc32, not builtin hash(): ids must be stable across
+            # processes (a pretrain run and a later fine-tune warm-start
+            # must agree), and hash() is salted per interpreter
             return [self.vocab.get(t) if t in self.vocab
-                    else ns + (hash(t) % (n - ns)) for t in tokens]
+                    else ns + (zlib.crc32(t.encode("utf-8")) % (n - ns))
+                    for t in tokens]
         return [self.vocab.get(t, self.vocab["[UNK]"]) for t in tokens]
 
     def encode_pair(self, text_a: str, text_b: Optional[str],

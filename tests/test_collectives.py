@@ -115,7 +115,10 @@ class TestThresholdFamilies:
         out, state = step(grads, batched_init_state(cfg))
         dense = jnp.mean(grads, axis=0)
         eps = float(eps_vs_dense(dense, out[0]))
-        assert eps < 0.95  # sparse result captures the dominant mass
+        # top-25%-|x| of N(0,1) carries ~60% of the squared mass, so a
+        # correct single-step selection lands near eps ~ 0.52 (measured);
+        # 0.65 leaves headroom without letting a broken selection pass
+        assert eps < 0.65
         assert int(state.last_local_count[0]) > 0
 
     def test_gaussiank_volume_tracks_counts(self, mesh8, grads):
@@ -135,20 +138,28 @@ class TestOkTopk:
         np.testing.assert_allclose(np.asarray(out[0]), want, atol=1e-5)
         np.testing.assert_allclose(np.asarray(out[5]), want, atol=1e-5)
 
-    def test_multi_step_eps_and_state(self, mesh8):
-        rng = np.random.RandomState(3)
+    def test_multi_step_eps_and_state(self, mesh8, grads):
+        """Error feedback must demonstrably *shrink* the cumulative error:
+        with a constant gradient, every element's residual grows until it
+        crosses the threshold and is sent, so the running sum of sparse
+        results converges toward the running sum of dense means (the
+        PROFILING_NORM standard, reference VGG/allreducer.py:1072-1080)."""
         cfg = make_cfg(density=0.05)
         step = build_allreduce_step("oktopk", cfg, mesh8, warmup=False)
         state = batched_init_state(cfg)
+        dense = np.asarray(grads).mean(0)
+        cum = np.zeros(N)
         epss = []
-        for i in range(6):
-            grads = jnp.asarray(rng.randn(P, N).astype(np.float32))
+        for i in range(8):
             out, state = step(grads, state)
-            dense = jnp.mean(grads, axis=0)
-            epss.append(float(eps_vs_dense(dense, out[0])))
-        assert int(state.step[0]) == 6
-        # winners carry the dominant mass; error feedback keeps EPS bounded
-        assert all(e < 1.1 for e in epss)
+            cum += np.asarray(out[0])
+            target = dense * (i + 1)
+            epss.append(float(np.linalg.norm(target - cum)
+                              / np.linalg.norm(target)))
+        assert int(state.step[0]) == 8
+        # measured trajectory 0.93 -> 0.66; a broken residual stays ~1.0
+        assert epss[-1] < 0.8 * epss[0]
+        assert epss[-1] < 0.75
         # thresholds became positive after the exact recomputes
         assert float(state.local_threshold[0]) > 0
         assert float(state.global_threshold[0]) > 0
@@ -167,14 +178,16 @@ class TestOkTopk:
         state = batched_init_state(cfg)
         base = rng.randn(P, n).astype(np.float32)
         vols = []
-        for i in range(8):
+        for i in range(12):
             grads = jnp.asarray(
                 base + 0.3 * rng.randn(P, n).astype(np.float32))
             _, state = step(grads, state)
             if i % 4 != 0:  # predicted-global steps
                 vols.append(float(state.last_volume[0]))
         budget = 6.0 * 2 * k        # 6k (index,value) elements = 12k scalars
-        assert min(vols) < budget
+        # the paper's property is the steady-state *mean*, not the best step
+        assert sum(vols) / len(vols) < budget, \
+            f"mean volume {sum(vols)/len(vols):.0f} vs 6k budget {budget}"
         for v in vols:
             assert v < 2 * budget, f"volume {v} vs budget {budget}"
             assert v < 2.0 * n / 4, "not meaningfully sparser than dense"
