@@ -31,12 +31,19 @@ def batched_init_state(cfg: OkTopkConfig, dtype=jnp.float32) -> SparseState:
 
 
 def build_allreduce_step(name: str, cfg: OkTopkConfig, mesh: Mesh,
-                         axis_name: str = "data", warmup: bool = True):
+                         axis_name: str = "data", warmup: bool = True,
+                         check_vma: bool = True):
     """jit-compiled ``(grads [P, n], state) -> (results [P, n], state)``.
 
     ``results`` is the same reduced vector replicated per worker row (every
     rank gets the full result, as after the reference's allgather phase).
+
+    ``check_vma=False`` disables shard_map's varying-axes tracking — needed
+    when running the Pallas selection kernel through its interpreter on a
+    CPU mesh (the interpreter cannot mix VMA-tracked operands).
     """
+    from oktopk_tpu.ops.compaction import resolve_use_pallas
+    cfg = resolve_use_pallas(cfg, mesh)
     algo = get_algorithm(name, warmup=warmup)
     spec = P(axis_name)
 
@@ -47,7 +54,8 @@ def build_allreduce_step(name: str, cfg: OkTopkConfig, mesh: Mesh,
         return out[None], jax.tree.map(lambda x: x[None], s2)
 
     mapped = jax.shard_map(shard_fn, mesh=mesh,
-                           in_specs=(spec, spec), out_specs=(spec, spec))
+                           in_specs=(spec, spec), out_specs=(spec, spec),
+                           check_vma=check_vma)
     return jax.jit(mapped)
 
 

@@ -30,7 +30,8 @@ def gaussian_k(grad: jnp.ndarray, state: SparseState, cfg: OkTopkConfig,
     acc = add_residual(grad, state.residual)
 
     t = gaussian_threshold(acc, k, cfg.gaussian_refine_iters).astype(acc.dtype)
-    vals, idx, count = select_by_threshold(acc, t, cap)
+    vals, idx, count = select_by_threshold(
+        acc, t, cap, use_pallas=bool(cfg.use_pallas))
     packed_mask = jnp.zeros((n,), bool).at[idx].set(True, mode="drop")
     residual = update_residual_at_selection(acc, packed_mask)
 

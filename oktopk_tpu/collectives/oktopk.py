@@ -164,10 +164,11 @@ def oktopk(grad: jnp.ndarray, state: SparseState, cfg: OkTopkConfig,
         lambda: state.boundaries)
 
     # ---- phase (a): select, exchange to region owners, scatter-add reduce.
+    up = bool(cfg.use_pallas)
     mask = abs_acc >= lt
     local_count = jnp.sum(mask)
     s_vals, s_idx, s_counts = pack_by_region(
-        acc, mask, boundaries, P, cfg.cap_pair)
+        acc, mask, boundaries, P, cfg.cap_pair, thresh=lt, use_pallas=up)
     r_vals = all_to_all(s_vals, axis_name)     # [P, cap_pair]
     r_idx = all_to_all(s_idx, axis_name)
     reduced = scatter_sparse(n, r_vals, r_idx)  # nonzero only in own region
@@ -201,8 +202,13 @@ def oktopk(grad: jnp.ndarray, state: SparseState, cfg: OkTopkConfig,
         # the new global threshold. No O(n log n) sort anywhere.
         t_cand = k2threshold_method(jnp.abs(reduced), k_cand,
                                     cfg.threshold_method, cfg.bisect_iters)
-        cand_mask = (jnp.abs(reduced) >= t_cand) & (reduced != 0.0)
-        vals, idx, cand_count = select_mask(reduced, cand_mask, k_cand)
+        if up:
+            # the kernel's min-normal clamp already excludes zeros
+            vals, idx, cand_count = select_by_threshold(
+                reduced, t_cand, k_cand, use_pallas=True)
+        else:
+            cand_mask = (jnp.abs(reduced) >= t_cand) & (reduced != 0.0)
+            vals, idx, cand_count = select_mask(reduced, cand_mask, k_cand)
         gv = all_gather(vals, axis_name)               # [P, k_cand]
         gi = all_gather(idx, axis_name)
         gt = k2threshold_method(jnp.abs(gv).reshape(-1), min(k, P * k_cand),
@@ -225,7 +231,8 @@ def oktopk(grad: jnp.ndarray, state: SparseState, cfg: OkTopkConfig,
         # drift rate (see the local-threshold block above) at zero comm
         # cost.
         gt_use = state.global_threshold * drift
-        gvals, gidx, gcount = select_by_threshold(reduced, gt_use, cap_g)
+        gvals, gidx, gcount = select_by_threshold(reduced, gt_use, cap_g,
+                                                  use_pallas=up)
         gv = all_gather(gvals, axis_name)              # [P, cap_g]
         gi = all_gather(gidx, axis_name)
         result = scatter_sparse(n, gv, gi)

@@ -85,7 +85,8 @@ def topk_a_opt(grad: jnp.ndarray, state: SparseState, cfg: OkTopkConfig,
                       cfg.bisect_iters).astype(acc.dtype),
                   lambda: state.local_threshold)
 
-    vals, idx, count = select_by_threshold(acc, lt, cap)
+    vals, idx, count = select_by_threshold(
+        acc, lt, cap, use_pallas=bool(cfg.use_pallas))
     packed_mask = jnp.zeros((n,), bool).at[idx].set(True, mode="drop")
     residual = update_residual_at_selection(acc, packed_mask)
 

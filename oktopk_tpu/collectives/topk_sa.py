@@ -44,7 +44,8 @@ def _split_allreduce(acc, lt, state: SparseState, cfg: OkTopkConfig,
     mask = jnp.abs(acc) >= lt
     local_count = jnp.sum(mask)
     s_vals, s_idx, s_counts = pack_by_region(
-        acc, mask, boundaries, P, cfg.cap_pair)
+        acc, mask, boundaries, P, cfg.cap_pair, thresh=lt,
+        use_pallas=bool(cfg.use_pallas))
     r_vals = all_to_all(s_vals, axis_name)
     r_idx = all_to_all(s_idx, axis_name)
     reduced = scatter_sparse(n, r_vals, r_idx)
@@ -60,7 +61,8 @@ def _split_allreduce(acc, lt, state: SparseState, cfg: OkTopkConfig,
     cap_g = cfg.cap_local
 
     def sparse_gather():
-        gvals, gidx, gcount = select_nonzero(reduced, cap_g)
+        gvals, gidx, gcount = select_nonzero(
+            reduced, cap_g, use_pallas=bool(cfg.use_pallas))
         gv = all_gather(gvals, axis_name)
         gi = all_gather(gidx, axis_name)
         result = scatter_sparse(n, gv, gi)

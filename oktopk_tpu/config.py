@@ -111,6 +111,12 @@ class OkTopkConfig:
     # reduced result is >= this dense (reference VGG/allreducer.py:1318-1351).
     sa_dense_fallback_ratio: float = 2.0 / 3.0
 
+    # Selection compaction backend: True = Pallas stream-compaction kernel
+    # (ops/compaction.py; TPU only), False = portable cumsum+scatter,
+    # None = resolve from the mesh backend at step-build time
+    # (collectives/api.py, optim/distributed.py).
+    use_pallas: Optional[bool] = None
+
     @property
     def k(self) -> int:
         """Target number of selected elements (k = density * n)."""

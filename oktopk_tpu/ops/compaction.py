@@ -1,0 +1,251 @@
+"""Stream compaction: pack masked elements into fixed-capacity buffers.
+
+This is the selection hot path of every sparse collective (SURVEY.md §7.3.5).
+The portable implementation (ops/select.py ``select_mask``) builds a full-
+length cumsum and a full-length scatter — on TPU that scatter serialises and
+dominates the train step. TPU has no scatter unit, so the fast path is a
+Pallas kernel that does what the hardware is good at:
+
+  per 1024-element block (one [8, 128] f32 tile):
+    mask -> in-block exclusive prefix sum (7+3 shifted adds on the VPU)
+    -> one-hot [1024, capb] matrix                 (VPU compares)
+    -> ONE [4, 1024] @ [1024, capb] MXU matmul     (the "scatter")
+    -> sliced DMA append to the output at the running base offset.
+
+The matmul compacts four row vectors at once: the value and the global index,
+each split into two 16-bit halves (every half is < 2^16 so it rides the MXU
+exactly regardless of f32 matmul precision; recombined by bit ops after the
+kernel). The running base lives in SMEM scratch and the grid is declared
+sequential ("arbitrary" dimension semantics), so each block's DMA lands after
+the previous block's — a block writes its full ``capb`` staging row and the
+next block's write overwrites the garbage tail, which is why the output
+carries ``capb`` slack slots that the caller masks off with the returned
+count.
+
+``capb`` — the per-block staging width — is ``min(BLK, cap)`` rounded up to
+a lane multiple, which makes the kernel's retention *identical* to the
+portable path's lowest-index-first-within-``cap``: a block can never need to
+contribute more than min(its survivors, remaining cap) <= capb slots to the
+global first-``cap`` prefix. The one-hot compare cost scales with ``capb``,
+so callers with small caps (the in-band sparse regime, a few percent of a
+block) pay for a narrow 128-wide matmul while rare large-cap calls (the
+periodic exact recompute) widen it.
+
+The reference's analogous code is the boolean-mask nonzero select
+(``compressbythreshold``, VGG/compression.py:122-142) — a cheap op on GPU,
+the wrong shape for TPU.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _interpret_default() -> bool:
+    """OKTOPK_PALLAS_INTERPRET=1 runs the kernel in the Pallas interpreter
+    (CPU-mesh tests of the full pallas-path algorithms)."""
+    return os.environ.get("OKTOPK_PALLAS_INTERPRET", "0") == "1"
+
+
+BLK_ROWS = 8          # f32 min tile is (8, 128)
+BLK_COLS = 128
+BLK = BLK_ROWS * BLK_COLS
+
+
+def _capb_for(cap: int) -> int:
+    """Per-block staging width: enough for any block's contribution to the
+    global first-``cap`` prefix, lane-aligned."""
+    need = min(BLK, cap)
+    return max(BLK_COLS, -(-need // BLK_COLS) * BLK_COLS)
+
+
+def _shift_right(x, d, axis):
+    """x shifted ``d`` slots toward higher indices along ``axis``, zero-fill."""
+    pad = [(0, 0), (0, 0)]
+    pad[axis] = (d, 0)
+    sl = [slice(None), slice(None)]
+    sl[axis] = slice(0, x.shape[axis] - d)
+    return jnp.pad(x[tuple(sl)], pad)
+
+
+def _block_prefix(m):
+    """Exclusive prefix sum of an [8, 128] i32 tile in row-major order,
+    via Hillis-Steele shifted adds (no cumsum primitive needed in-kernel)."""
+    s = m
+    for d in (1, 2, 4, 8, 16, 32, 64):           # within-row inclusive scan
+        s = s + _shift_right(s, d, axis=1)
+    row_tot = s[:, -1:]                           # [8, 1]
+    r = row_tot
+    for d in (1, 2, 4):                           # across-row inclusive scan
+        r = r + _shift_right(r, d, axis=0)
+    row_excl = r - row_tot                        # exclusive row offsets
+    return s - m + row_excl, r[-1, 0]             # (excl. positions, total)
+
+
+def _compact_kernel(capb, t_ref, r_ref, x_ref, vh_ref, vl_ref, ih_ref,
+                    il_ref, cnt_ref, base_ref, stage_ref, sem_ref):
+    import jax.experimental.pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    i = pl.program_id(0)
+    nblocks = pl.num_programs(0)
+
+    @pl.when(i == 0)
+    def _():
+        base_ref[0] = 0
+
+    x = x_ref[:]                                          # [8, 128] f32
+    gidx = (i * BLK
+            + jax.lax.broadcasted_iota(jnp.int32, (BLK_ROWS, BLK_COLS), 0)
+            * BLK_COLS
+            + jax.lax.broadcasted_iota(jnp.int32, (BLK_ROWS, BLK_COLS), 1))
+    # [lo, hi) element-range restriction (region packing); full range for a
+    # whole-vector select
+    mask = ((jnp.abs(x) >= t_ref[0])
+            & (gidx >= r_ref[0]) & (gidx < r_ref[1]))
+    m = mask.astype(jnp.int32)
+    pos, _ = _block_prefix(m)
+
+    kept = mask & (pos < capb)
+    sel = jnp.where(kept, pos, capb)                      # capb = dropped
+    stored = jnp.sum(kept.astype(jnp.int32))
+
+    # one-hot compaction matrix [BLK, capb]
+    sel_flat = sel.reshape(BLK, 1)
+    onehot = (sel_flat == jax.lax.broadcasted_iota(
+        jnp.int32, (BLK, capb), 1)).astype(jnp.float32)
+
+    # rows: value hi/lo halves and global-index hi/lo halves — 16-bit
+    # pieces are exact in any MXU f32 path
+    vbits = pltpu.bitcast(x, jnp.int32)
+    zero = jnp.zeros_like(vbits)
+    rows = jnp.stack([
+        jnp.where(kept, vbits >> 16, zero),               # arithmetic shift
+        jnp.where(kept, vbits & 0xFFFF, zero),
+        jnp.where(kept, gidx >> 16, zero),
+        jnp.where(kept, gidx & 0xFFFF, zero),
+    ]).reshape(4, BLK).astype(jnp.float32)
+
+    stage_ref[:] = jax.lax.dot_general(
+        rows, onehot, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)               # [4, capb]
+
+    base = base_ref[0]
+    cap = vh_ref.shape[0] - capb                          # slack appended
+    base_w = jnp.minimum(base, cap)
+    for j, out in enumerate((vh_ref, vl_ref, ih_ref, il_ref)):
+        copy = pltpu.make_async_copy(
+            stage_ref.at[j], out.at[pl.ds(base_w, capb)], sem_ref)
+        copy.start()
+        copy.wait()
+
+    base_ref[0] = base_w + stored
+
+    @pl.when(i == nblocks - 1)
+    def _():
+        cnt_ref[0, 0] = jnp.minimum(base_ref[0], cap)     # stored (<= cap)
+
+
+@functools.partial(jax.jit, static_argnames=("cap", "interpret"))
+def select_by_threshold_pallas(x: jnp.ndarray, thresh, cap: int,
+                               lo=None, hi=None,
+                               interpret: bool | None = None):
+    """Fixed-capacity threshold select, Pallas TPU fast path.
+
+    Same contract as ops.select.select_by_threshold: returns
+    ``(values[cap], indices[cap], count)`` with slots >= count holding
+    value 0 / index n, elements packed in ascending index order, overflow
+    beyond ``cap`` dropped with lowest-index-first retention (identical to
+    the portable path — see the module docstring on ``capb``). ``lo``/``hi``
+    restrict selection to the element range [lo, hi) — the per-region form
+    used by region packing.
+
+    The threshold is clamped to the smallest normal f32, so a zero/negative
+    threshold selects every nonzero element rather than the padded tail
+    (subnormals flush to zero on TPU anyway).
+    """
+    import jax.experimental.pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    if interpret is None:
+        interpret = _interpret_default()
+    n = x.size
+    capb = _capb_for(cap)
+    pad = (-n) % BLK
+    xp = jnp.pad(x.reshape(-1), (0, pad)).reshape(-1, BLK_COLS)
+    nblocks = xp.shape[0] // BLK_ROWS
+    t = jnp.reshape(jnp.maximum(jnp.asarray(thresh, x.dtype),
+                                jnp.float32(1.17549435e-38)), (1,))
+    rng = jnp.stack([
+        jnp.asarray(0 if lo is None else lo, jnp.int32),
+        jnp.asarray(n if hi is None else hi, jnp.int32)])
+
+    # under shard_map's VMA tracking the outputs vary over the same mesh
+    # axes as the input shard, and every operand must agree
+    try:
+        vma = jax.typeof(xp).vma
+    except Exception:
+        vma = frozenset()
+    if vma:
+        t = jax.lax.pvary(t, tuple(vma - jax.typeof(t).vma))
+        rng = jax.lax.pvary(rng, tuple(vma - jax.typeof(rng).vma))
+    out_shapes = [jax.ShapeDtypeStruct((cap + capb,), jnp.float32, vma=vma)
+                  for _ in range(4)]
+    out_shapes.append(jax.ShapeDtypeStruct((1, 1), jnp.int32, vma=vma))
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(nblocks,),
+        in_specs=[pl.BlockSpec((BLK_ROWS, BLK_COLS),
+                               lambda i, t, r: (i, 0))],
+        out_specs=[pl.BlockSpec(memory_space=pl.ANY)] * 4
+        + [pl.BlockSpec(memory_space=pltpu.SMEM)],
+        scratch_shapes=[
+            pltpu.SMEM((1,), jnp.int32),
+            pltpu.VMEM((4, capb), jnp.float32),
+            pltpu.SemaphoreType.DMA,
+        ],
+    )
+    vh, vl, ih, il, cnts = pl.pallas_call(
+        functools.partial(_compact_kernel, capb),
+        grid_spec=grid_spec,
+        out_shape=out_shapes,
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary",)),
+        interpret=interpret,
+    )(t, rng, xp)
+
+    count = cnts[0, 0]
+    live = jnp.arange(cap) < count
+    vbits = ((vh[:cap].astype(jnp.int32) << 16)
+             | (vl[:cap].astype(jnp.int32) & 0xFFFF))
+    values = jnp.where(live, jax.lax.bitcast_convert_type(vbits, jnp.float32),
+                       0.0)
+    indices = jnp.where(
+        live,
+        (ih[:cap].astype(jnp.int32) << 16)
+        | (il[:cap].astype(jnp.int32) & 0xFFFF),
+        n).astype(jnp.int32)
+    return values, indices, count
+
+
+def mesh_supports_pallas(mesh) -> bool:
+    """True when every device of the mesh is a TPU (incl. the tunnelled
+    "axon" platform) — the backends the compaction kernel targets."""
+    try:
+        plats = {d.platform for d in np.asarray(mesh.devices).flat}
+    except Exception:
+        return False
+    return bool(plats) and plats.issubset({"tpu", "axon"})
+
+
+def resolve_use_pallas(cfg, mesh):
+    """Fill OkTopkConfig.use_pallas from the mesh backend when unset."""
+    if cfg.use_pallas is not None:
+        return cfg
+    return cfg.replace(use_pallas=mesh_supports_pallas(mesh))

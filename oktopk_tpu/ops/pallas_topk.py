@@ -1,62 +1,29 @@
-"""Pallas TPU kernels for the selection hot path + sort-free thresholds.
+"""Sort-free selection thresholds (the periodic exact recomputes).
 
 The reference's exact threshold recompute is ``torch.topk`` on the full flat
 gradient (VGG/compression.py:86-106) — O(n log n) and the reason it only
 recomputes every 32 steps. On TPU a k-th-value threshold only needs
-*counting*, not sorting: bisection on the value axis with a fused
-abs-compare-count per trip (O(iters·n) VPU work, no sort, SURVEY.md §7.3.5).
+*counting*, not sorting: multi-way bisection on the value axis with a fused
+compare-and-count per trip (O(passes*n) VPU work, no sort, SURVEY.md
+§7.3.5). XLA fuses each pass's searchsorted-compare-reduce into one
+HBM-bandwidth-bound sweep, so no hand-written kernel is needed here; the
+Pallas effort goes to the compaction that *uses* the threshold
+(ops/compaction.py), where the portable path's giant scatter is the real
+TPU bottleneck.
 
-``count_ge`` is the Pallas kernel (blocked VMEM reduction); on non-TPU
-backends it falls back to plain jnp (the tests run on the CPU mesh).
-``k2threshold_bisect`` is the sort-free replacement for
-``ops.topk.k2threshold``, selectable via ``OkTopkConfig.threshold_method``.
+``k2threshold_bisect`` replaces ``ops.topk.k2threshold``'s sort, selectable
+via ``OkTopkConfig.threshold_method`` ("bisect" is the default).
 """
 
 from __future__ import annotations
 
-import functools
-
-import jax
 import jax.numpy as jnp
 from jax import lax
-
-_BLOCK = 8 * 1024
-
-
-def _count_kernel(x_ref, t_ref, out_ref):
-    out_ref[0] = jnp.sum(
-        (jnp.abs(x_ref[:]) >= t_ref[0]).astype(jnp.int32))
-
-
-@functools.partial(jax.jit, static_argnames=("use_pallas",))
-def count_ge(x: jnp.ndarray, thresh, use_pallas: bool = False):
-    """Number of elements with |x| >= thresh."""
-    if not use_pallas:
-        return jnp.sum(jnp.abs(x) >= thresh)
-
-    from jax.experimental import pallas as pl
-
-    n = x.size
-    pad = (-n) % _BLOCK
-    xp = jnp.pad(x.reshape(-1), (0, pad))      # zeros never pass t > 0
-    nblocks = xp.size // _BLOCK
-    t = jnp.reshape(thresh.astype(x.dtype), (1,))
-    partial_counts = pl.pallas_call(
-        _count_kernel,
-        grid=(nblocks,),
-        in_specs=[pl.BlockSpec((_BLOCK,), lambda i: (i,)),
-                  pl.BlockSpec((1,), lambda i: (0,))],
-        out_specs=pl.BlockSpec((1,), lambda i: (i,)),
-        out_shape=jax.ShapeDtypeStruct((nblocks,), jnp.int32),
-    )(xp, t)
-    return jnp.sum(partial_counts)
-
 
 _WAYS = 8  # brackets per pass; each memory pass narrows log2(_WAYS) bits
 
 
-def k2threshold_bisect(x_abs: jnp.ndarray, k: int, iters: int = 30,
-                       use_pallas: bool = False):
+def k2threshold_bisect(x_abs: jnp.ndarray, k: int, iters: int = 30):
     """Sort-free k-th-largest estimate to ``iters`` bits of precision.
 
     Multi-way bisection: each trip splits the bracket [lo, hi) into

@@ -28,7 +28,8 @@ def count_by_threshold(x: jnp.ndarray, thresh) -> jnp.ndarray:
     return jnp.sum(jnp.abs(x) >= thresh)
 
 
-def select_by_threshold(x: jnp.ndarray, thresh, cap: int):
+def select_by_threshold(x: jnp.ndarray, thresh, cap: int,
+                        use_pallas: bool = False):
     """Pack elements with |x| >= thresh into a fixed-capacity triple.
 
     Replaces reference ``compressbythreshold`` (VGG/compression.py:122-142),
@@ -38,7 +39,15 @@ def select_by_threshold(x: jnp.ndarray, thresh, cap: int):
     value 0 and index n. Elements are packed in ascending index order; if more
     than ``cap`` elements pass the threshold the tail is dropped (and should
     remain in the caller's residual).
+
+    ``use_pallas`` selects the TPU stream-compaction kernel
+    (ops/compaction.py) instead of the portable cumsum+scatter, which
+    serialises on TPU. Resolved from the mesh backend by the step builders
+    (OkTopkConfig.use_pallas).
     """
+    if use_pallas and x.dtype == jnp.float32:   # kernel is f32-only
+        from oktopk_tpu.ops.compaction import select_by_threshold_pallas
+        return select_by_threshold_pallas(x, thresh, cap)
     return select_mask(x, jnp.abs(x) >= thresh, cap)
 
 
@@ -56,13 +65,18 @@ def select_mask(x: jnp.ndarray, mask: jnp.ndarray, cap: int):
     return values, indices, count
 
 
-def select_nonzero(x: jnp.ndarray, cap: int):
+def select_nonzero(x: jnp.ndarray, cap: int, use_pallas: bool = False):
     """Pack the nonzeros of ``x`` (the reference's plain nonzero extract of
     its reduced region before Allgatherv, VGG/allreducer.py:1326).
 
-    Do NOT emulate this with a tiny threshold: subnormal thresholds flush to
-    zero on TPU/XLA and select everything.
+    The portable path must NOT emulate this with a tiny threshold:
+    subnormal thresholds flush to zero on TPU/XLA and select everything.
+    The Pallas path clamps its threshold to the smallest *normal* f32,
+    which selects exactly the nonzeros on TPU (subnormals flush there).
     """
+    if use_pallas and x.dtype == jnp.float32:   # kernel is f32-only
+        from oktopk_tpu.ops.compaction import select_by_threshold_pallas
+        return select_by_threshold_pallas(x, 0.0, cap)
     return select_mask(x, x != 0.0, cap)
 
 
@@ -80,7 +94,8 @@ def scatter_sparse(n: int, values: jnp.ndarray, indices: jnp.ndarray,
 
 
 def pack_by_region(x: jnp.ndarray, mask: jnp.ndarray,
-                   boundaries: jnp.ndarray, num_regions: int, cap: int):
+                   boundaries: jnp.ndarray, num_regions: int, cap: int,
+                   thresh=None, use_pallas: bool = False):
     """Pack masked elements of ``x`` into per-region fixed-capacity buffers.
 
     This is the TPU form of oktopk phase (a)'s send-side: the reference
@@ -98,12 +113,27 @@ def pack_by_region(x: jnp.ndarray, mask: jnp.ndarray,
         boundaries[0] == 0, boundaries[-1] == n (the reference's invariant
         ``sum(boundaries) == tensor_size``, VGG/allreducer.py:648).
       cap: per-region capacity.
+      thresh: when given (with ``use_pallas``), the mask is known to be
+        ``|x| >= thresh`` and the TPU compaction kernel packs each region
+        directly (one range-restricted pass per region) instead of the
+        portable full-length cumsum + scatter.
 
     Returns:
       (values [num_regions, cap], indices [num_regions, cap] with global
       element ids, counts [num_regions] clipped to cap).
     """
     n = x.size
+    if use_pallas and thresh is not None and x.dtype == jnp.float32:
+        from oktopk_tpu.ops.compaction import select_by_threshold_pallas
+        vs, ids_, cs = [], [], []
+        for r in range(num_regions):
+            v, i, c = select_by_threshold_pallas(
+                x, thresh, cap, lo=boundaries[r], hi=boundaries[r + 1])
+            vs.append(v)
+            ids_.append(i)
+            cs.append(c)
+        return (jnp.stack(vs), jnp.stack(ids_),
+                jnp.stack(cs).astype(jnp.int32))
     ids = jnp.arange(n, dtype=jnp.int32)
     # region id per element; boundaries[1:-1] are the interior cut points.
     rid = jnp.searchsorted(boundaries[1:-1], ids, side="right").astype(jnp.int32)

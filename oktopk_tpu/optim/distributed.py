@@ -108,6 +108,8 @@ def build_sparse_grad_step(
     ``batch`` leaves are [num_workers * nsteps_update * mb, ...] and get
     sharded over the data axis.
     """
+    from oktopk_tpu.ops.compaction import resolve_use_pallas
+    cfg = resolve_use_pallas(cfg, mesh)
     algo = get_algorithm(compressor, warmup=warmup)
 
     def shard_fn(state: DistTrainState, batch, rng):

@@ -1,0 +1,101 @@
+"""Profile the oktopk selection hot path piecewise on the real chip.
+
+Times (ms, steady-state mean over iters) for n ~ VGG16 grad size:
+  - k2threshold_bisect (current multi-way bisection)
+  - lax.top_k-based k2threshold (sort)
+  - pack_by_region (phase-a packing)
+  - select_by_threshold (phase-b select)
+  - dense fwd+bwd+sgd VGG16 step
+  - full oktopk VGG16 step
+"""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _sync(out):
+    """Honest sync: through the axon tunnel block_until_ready can return
+    before execution finishes — fetch a leaf to host instead."""
+    leaf = jax.tree_util.tree_leaves(out)[0]
+    np.asarray(leaf if leaf.ndim == 0 else leaf.reshape(-1)[0])
+
+
+def bench_fn(fn, *args, iters=10, warmup=2):
+    for _ in range(warmup):
+        out = fn(*args)
+    _sync(out)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(*args)
+    _sync(out)
+    return (time.perf_counter() - t0) / iters * 1e3
+
+
+def main():
+    dev = jax.devices()[0]
+    print(f"device: {dev}", flush=True)
+    n = 14_700_000
+    k = int(0.02 * n)
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(n).astype(np.float32))
+    xa = jnp.abs(x)
+
+    from oktopk_tpu.ops.pallas_topk import k2threshold_bisect
+    from oktopk_tpu.ops.topk import k2threshold
+    from oktopk_tpu.ops.select import pack_by_region, select_by_threshold
+
+    f_bisect = jax.jit(lambda a: k2threshold_bisect(a, k))
+    print(f"bisect(n={n}): {bench_fn(f_bisect, xa):.1f} ms", flush=True)
+
+    f_sort = jax.jit(lambda a: k2threshold(a, k))
+    print(f"topk-sort(n={n}): {bench_fn(f_sort, xa):.1f} ms", flush=True)
+
+    P = 8
+    cap = int(2.0 * k / P) + 8
+    bounds = jnp.asarray(np.linspace(0, n, P + 1).astype(np.int32))
+    t = jnp.float32(2.054)  # ~top2% of N(0,1)
+    f_pack = jax.jit(lambda v: pack_by_region(v, jnp.abs(v) >= t, bounds, P, cap))
+    print(f"pack_by_region: {bench_fn(f_pack, x):.1f} ms", flush=True)
+
+    capg = int(2.5 * k / P) + 8
+    f_sel = jax.jit(lambda v: select_by_threshold(v, t, capg))
+    print(f"select_by_threshold: {bench_fn(f_sel, x):.1f} ms", flush=True)
+
+    # count only
+    f_cnt = jax.jit(lambda a: jnp.sum(a >= t))
+    print(f"plain count: {bench_fn(f_cnt, xa):.2f} ms", flush=True)
+
+    if "--steps" not in sys.argv:
+        return
+
+    from oktopk_tpu.comm.mesh import get_mesh
+    from oktopk_tpu.config import TrainConfig
+    from oktopk_tpu.data.synthetic import synthetic_batch
+    from oktopk_tpu.train.trainer import Trainer
+
+    mesh = get_mesh((1,), ("data",), devices=[dev])
+    for comp in ("dense", "oktopk"):
+        cfg = TrainConfig(dnn="vgg16", dataset="cifar10", batch_size=16,
+                          lr=0.1, compressor=comp, density=0.02,
+                          num_workers=1)
+        trainer = Trainer(cfg, mesh=mesh, warmup=False)
+        batch = jax.device_put(
+            synthetic_batch("vgg16", 16, np.random.RandomState(0)))
+        m = trainer.train_step(batch)
+        _sync(m["loss"])
+        t0 = time.perf_counter()
+        for _ in range(10):
+            m = trainer.train_step(batch)
+        _sync(m["loss"])
+        dt = (time.perf_counter() - t0) / 10
+        print(f"vgg16 {comp} step: {dt*1e3:.1f} ms", flush=True)
+
+
+if __name__ == "__main__":
+    main()

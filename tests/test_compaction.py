@@ -1,0 +1,129 @@
+"""Parity tests for the Pallas stream-compaction fast path
+(ops/compaction.py) against the portable ops/select.py implementation.
+
+Runs the kernel in interpret mode on CPU (the real-TPU path is exercised by
+bench.py / scripts/profile_tpu.py on hardware); the contract is identical:
+(values[cap], indices[cap], count), ascending index order, sentinel n,
+overflow dropped lowest-index-first (plus the documented per-block CAPB
+bound)."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from oktopk_tpu.ops.compaction import BLK, select_by_threshold_pallas
+from oktopk_tpu.ops.select import select_by_threshold
+
+
+def run_both(x, thresh, cap):
+    got = select_by_threshold_pallas(jnp.asarray(x), thresh, cap,
+                                     interpret=True)
+    want = select_by_threshold(jnp.asarray(x), thresh, cap)
+    return [np.asarray(g) for g in got], [np.asarray(w) for w in want]
+
+
+class TestCompactionParity:
+    @pytest.mark.parametrize("n", [BLK, 3 * BLK, 4 * BLK + 777])
+    def test_matches_portable_select(self, n):
+        rng = np.random.RandomState(0)
+        x = rng.randn(n).astype(np.float32)
+        t = 2.0                      # ~2.3% of N(0,1) passes
+        cap = max(64, int(0.05 * n))
+        (gv, gi, gc), (wv, wi, wc) = run_both(x, t, cap)
+        assert gc == wc
+        np.testing.assert_array_equal(gi, wi)
+        np.testing.assert_array_equal(gv, wv)
+
+    def test_bit_exact_values(self):
+        rng = np.random.RandomState(1)
+        # adversarial float bit patterns: subnormals excluded (threshold),
+        # but mixed signs/exponents must survive the 16-bit split exactly
+        x = (rng.randn(2 * BLK) * 10.0 ** rng.randint(-6, 6, 2 * BLK))
+        x = x.astype(np.float32)
+        t = float(np.quantile(np.abs(x), 0.97))
+        (gv, gi, gc), (wv, wi, wc) = run_both(x, t, 4096)
+        assert gc == wc
+        np.testing.assert_array_equal(gv.view(np.int32), wv.view(np.int32))
+
+    def test_cap_overflow_drops_tail(self):
+        rng = np.random.RandomState(2)
+        x = rng.randn(4 * BLK).astype(np.float32)
+        t = 0.5                      # ~62% pass -> far over cap
+        cap = 256
+        (gv, gi, gc), (wv, wi, wc) = run_both(x, t, cap)
+        assert gc == wc == cap
+        # lowest-index-first retention identical to the portable path
+        np.testing.assert_array_equal(gi, wi)
+        np.testing.assert_array_equal(gv, wv)
+
+    def test_empty_selection(self):
+        x = np.zeros(2 * BLK, np.float32)
+        gv, gi, gc = [np.asarray(a) for a in
+                      select_by_threshold_pallas(jnp.asarray(x), 1.0, 128,
+                                                 interpret=True)]
+        assert gc == 0
+        assert (gi == x.size).all()
+        assert (gv == 0).all()
+
+    def test_fully_dense_block(self):
+        """cap >= BLK: a fully dense block is retained whole."""
+        x = np.ones(2 * BLK, np.float32)
+        x[BLK:] = 0.0
+        (gv, gi, gc), (wv, wi, wc) = run_both(x, 0.5, 2 * BLK)
+        assert gc == wc == BLK
+        np.testing.assert_array_equal(gi, wi)
+        np.testing.assert_array_equal(gv, wv)
+
+    def test_range_restriction(self):
+        rng = np.random.RandomState(3)
+        x = rng.randn(3 * BLK).astype(np.float32)
+        lo, hi = BLK // 2, 2 * BLK + 17
+        gv, gi, gc = [np.asarray(a) for a in
+                      select_by_threshold_pallas(
+                          jnp.asarray(x), 2.0, 512,
+                          lo=jnp.int32(lo), hi=jnp.int32(hi),
+                          interpret=True)]
+        want = np.where(np.abs(x) >= 2.0)[0]
+        want = want[(want >= lo) & (want < hi)]
+        assert gc == len(want)
+        np.testing.assert_array_equal(gi[:gc], want)
+        np.testing.assert_array_equal(gv[:gc], x[want])
+
+
+class TestOkTopkPallasParity:
+    def test_full_algorithm_matches_portable(self, mesh8, monkeypatch):
+        """The whole oktopk step with the Pallas selection path (interpret
+        mode) must produce the same reduced result, volumes and state as
+        the portable path when counts sit inside the capacity bounds."""
+        monkeypatch.setenv("OKTOPK_PALLAS_INTERPRET", "1")
+        from oktopk_tpu.collectives.api import (batched_init_state,
+                                                build_allreduce_step)
+        from oktopk_tpu.config import OkTopkConfig
+
+        P, n = 8, 8192
+        rng = np.random.RandomState(4)
+        base = rng.randn(P, n).astype(np.float32)
+        outs, states = {}, {}
+        for up in (False, True):
+            cfg = OkTopkConfig(n=n, num_workers=P, density=0.05,
+                               warmup_steps=0, local_recompute_every=2,
+                               global_recompute_every=4, use_pallas=up)
+            # check_vma=False: the Pallas interpreter cannot mix VMA-tracked
+            # operands (real-TPU compiles through Mosaic instead)
+            step = build_allreduce_step("oktopk", cfg, mesh8, warmup=False,
+                                        check_vma=not up)
+            state = batched_init_state(cfg)
+            rs = []
+            for i in range(4):
+                out, state = step(jnp.asarray(base), state)
+                rs.append(np.asarray(out[0]))
+            outs[up], states[up] = rs, state
+        for a, b in zip(outs[False], outs[True]):
+            np.testing.assert_allclose(a, b, atol=1e-6)
+        np.testing.assert_allclose(
+            np.asarray(states[False].last_volume),
+            np.asarray(states[True].last_volume))
+        np.testing.assert_allclose(
+            np.asarray(states[False].residual),
+            np.asarray(states[True].residual), atol=1e-6)

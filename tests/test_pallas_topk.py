@@ -1,20 +1,10 @@
-"""Tests for the sort-free threshold path (count bisection; the Pallas
-count kernel itself needs TPU — exercised via the jnp fallback here and by
-identical code paths on hardware)."""
+"""Tests for the sort-free threshold path (count bisection)."""
 
 import jax.numpy as jnp
 import numpy as np
 
-from oktopk_tpu.ops.pallas_topk import count_ge, k2threshold_bisect
+from oktopk_tpu.ops.pallas_topk import k2threshold_bisect
 from oktopk_tpu.ops.topk import k2threshold
-
-
-class TestCountGe:
-    def test_matches_numpy(self, rng):
-        x = jnp.asarray(rng.randn(1000).astype(np.float32))
-        t = 0.7
-        assert int(count_ge(x, jnp.asarray(t))) == int(
-            np.sum(np.abs(np.asarray(x)) >= t))
 
 
 class TestBisect:
