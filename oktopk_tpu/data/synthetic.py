@@ -56,3 +56,32 @@ def synthetic_iterator(dnn: str, batch_size: int, seed: int = 0,
     rng = np.random.RandomState(seed)
     while True:
         yield synthetic_batch(dnn, batch_size, rng, seq_len)
+
+
+def teacher_iterator(dnn: str, batch_size: int, num_examples: int = 512,
+                     seed: int = 0) -> Iterator[Dict[str, np.ndarray]]:
+    """Finite image dataset with *learnable* labels from a fixed random
+    linear teacher (label = argmax(W @ flatten(image))).
+
+    Random labels are unfittable in expectation, which makes loss curves
+    meaningless for convergence comparisons; a teacher labeling gives every
+    optimizer the same structured task, so dense-vs-sparse gaps measure the
+    compression, not noise memorisation. Used by the convergence harness
+    (scripts/convergence.py, tests/test_convergence.py) — the stand-in for
+    the reference's accuracy-log runs (VGG/dl_trainer.py:606-616)."""
+    rng = np.random.RandomState(seed)
+    proto = synthetic_batch(dnn, num_examples, rng)
+    if "image" not in proto:
+        raise ValueError(f"teacher_iterator supports image workloads, "
+                         f"not {dnn}")
+    images = proto["image"]
+    nclass = int(proto["label"].max()) + 1
+    w = rng.randn(images[0].size, nclass).astype(np.float32)
+    logits = images.reshape(num_examples, -1) @ w
+    labels = np.argmax(logits, axis=1).astype(np.int32)
+    order_rng = np.random.RandomState(seed + 1)
+    while True:
+        order = order_rng.permutation(num_examples)
+        for i in range(0, num_examples - batch_size + 1, batch_size):
+            sel = order[i:i + batch_size]
+            yield {"image": images[sel], "label": labels[sel]}

@@ -1,0 +1,157 @@
+"""Staged BERT: the pretraining model split for pipeline parallelism.
+
+Reference parity: the staged model zoo (C16) — BERT split into N stages of
+``BertLayer``s with an embedding-carrying start stage and a head-carrying
+end stage that re-ties the word-embedding table
+(/root/reference/BERT/bert/models/bert/depth=4/__init__.py:12-19, stage
+modules start_stage.py/intermediate_stage.py/end_stage.py), consumed by the
+StageRuntime (BERT/runtime.py:842).
+
+TPU-first decomposition: the reference carves the module list into
+heterogeneous stage objects and moves tensors by name between processes.
+Under SPMD the pipeline wants one homogeneous program per rank, so the split
+is:
+
+- **Pipelined**: the ``num_layers`` transformer blocks, ``layers_per_stage``
+  per pipeline rank, parameters stacked on a leading stage axis (sharded
+  over the ``pipe`` mesh axis). Every activation on the wire is one
+  [mb, T, H] tensor — the restriction parallel/pipeline.py documents.
+- **Replicated**: embeddings, pooler and the MLM/NSP heads. Embedding
+  lookup is memory-bound-cheap and the head needs the embedding table
+  anyway (weight tying), so replicating both keeps the tie exact with zero
+  cross-stage traffic — the reference instead passes the table object
+  between its first and last stage, which only works because its shipped
+  configs run every stage in one process (SURVEY.md §2.3). The cost is the
+  LM-head matmul running on every pipe rank; their grads are psum'd over
+  the pipe axis (nonzero only where the fwd actually consumed them).
+
+``split``/``merge`` convert between this layout and the single-module
+``BertForPreTraining`` params, so checkpoints interchange and equivalence
+is testable layer-for-layer.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from oktopk_tpu.models.bert import (BertConfig, BertEmbeddings,
+                                    BertForPreTraining, BertLayer)
+
+
+class StagedBertPretrain:
+    """Functional views of BertForPreTraining for the pipeline runtime."""
+
+    def __init__(self, cfg: BertConfig, num_stages: int):
+        if cfg.num_layers % num_stages != 0:
+            raise ValueError(
+                f"num_layers={cfg.num_layers} not divisible by "
+                f"num_stages={num_stages}")
+        self.cfg = cfg
+        self.num_stages = num_stages
+        self.layers_per_stage = cfg.num_layers // num_stages
+        self._module = BertForPreTraining(cfg)
+        self._emb = BertEmbeddings(cfg)
+        self._layer = BertLayer(cfg)
+
+    # ---- parameter layout -------------------------------------------------
+
+    def init(self, rng, batch_size: int = 2, seq_len: int = 16):
+        ex = jnp.zeros((batch_size, seq_len), jnp.int32)
+        return self._module.init(
+            {"params": rng, "dropout": jax.random.fold_in(rng, 1)},
+            ex, ex, jnp.ones_like(ex), train=False)["params"]
+
+    def split(self, params) -> Tuple[Any, Dict[str, Any]]:
+        """Single-module params -> (stage_stack, shared).
+
+        ``stage_stack`` leaves carry a leading [num_stages] axis (shard over
+        the pipe axis); per-stage structure is {"sub_0".."sub_{k-1}"} of
+        BertLayer params. ``shared`` holds embeddings/pooler/heads."""
+        enc = params["bert"]["encoder"]
+        k = self.layers_per_stage
+        per_stage = [
+            {f"sub_{j}": enc[f"layer_{s * k + j}"] for j in range(k)}
+            for s in range(self.num_stages)
+        ]
+        stage_stack = jax.tree.map(lambda *xs: jnp.stack(xs), *per_stage)
+        shared = {
+            "embeddings": params["bert"]["embeddings"],
+            "pooler": params["bert"]["pooler"],
+            "mlm_dense": params["mlm_dense"],
+            "mlm_ln": params["mlm_ln"],
+            "mlm_bias": params["mlm_bias"],
+            "nsp": params["nsp"],
+        }
+        return stage_stack, shared
+
+    def merge(self, stage_stack, shared):
+        """Inverse of :meth:`split` (checkpoint interchange)."""
+        k = self.layers_per_stage
+        enc = {}
+        for s in range(self.num_stages):
+            stage = jax.tree.map(lambda x: x[s], stage_stack)
+            for j in range(k):
+                enc[f"layer_{s * k + j}"] = stage[f"sub_{j}"]
+        return {
+            "bert": {"embeddings": shared["embeddings"],
+                     "encoder": enc,
+                     "pooler": shared["pooler"]},
+            "mlm_dense": shared["mlm_dense"],
+            "mlm_ln": shared["mlm_ln"],
+            "mlm_bias": shared["mlm_bias"],
+            "nsp": shared["nsp"],
+        }
+
+    # ---- functional pieces ------------------------------------------------
+
+    def attn_mask(self, attention_mask):
+        """[B, T] 0/1 -> boolean [B, 1, T, T] attend-mask (models/bert.py)."""
+        B, T = attention_mask.shape
+        m = attention_mask[:, None, None, :].astype(bool)
+        return jnp.broadcast_to(m, (B, 1, T, T))
+
+    def embed(self, shared, input_ids, token_type_ids, train: bool = False,
+              rngs=None):
+        return self._emb.apply({"params": shared["embeddings"]},
+                               input_ids, token_type_ids, train,
+                               rngs=rngs)
+
+    def apply_stage(self, stage_params, x, attn_mask, train: bool = False,
+                    rngs=None):
+        """Run this stage's ``layers_per_stage`` BertLayers."""
+        for j in range(self.layers_per_stage):
+            x = self._layer.apply({"params": stage_params[f"sub_{j}"]},
+                                  x, attn_mask, train, rngs=rngs)
+        return x
+
+    def head_logits(self, shared, h, train: bool = False):
+        """(mlm_logits, nsp_logits) from final hidden states [B, T, H] —
+        the math of BertForPreTraining.__call__ after the encoder."""
+        c = self.cfg
+        pooled = jnp.tanh(nn.Dense(c.hidden_size, dtype=c.dtype).apply(
+            {"params": shared["pooler"]}, h[:, 0]))
+        hm = nn.Dense(c.hidden_size, dtype=c.dtype).apply(
+            {"params": shared["mlm_dense"]}, h)
+        hm = nn.gelu(hm, approximate=False)
+        hm = nn.LayerNorm(epsilon=c.layer_norm_eps, dtype=c.dtype).apply(
+            {"params": shared["mlm_ln"]}, hm)
+        table = shared["embeddings"]["word_embeddings"]["embedding"]
+        mlm = jnp.einsum("bth,vh->btv", hm, table.astype(c.dtype))
+        mlm = mlm + shared["mlm_bias"]
+        nsp = nn.Dense(2, dtype=c.dtype).apply({"params": shared["nsp"]},
+                                               pooled)
+        return mlm.astype(jnp.float32), nsp.astype(jnp.float32)
+
+    def reference_loss(self, params, batch, train: bool = False, rngs=None):
+        """Single-module loss on the same batch (equivalence oracle)."""
+        from oktopk_tpu.train import losses
+        mlm, nsp = self._module.apply(
+            {"params": params}, batch["input_ids"], batch["token_type_ids"],
+            batch["attention_mask"], train=train, rngs=rngs)
+        loss, _ = losses.bert_pretrain_loss(mlm, nsp, batch["mlm_labels"],
+                                            batch["nsp_labels"])
+        return loss

@@ -1,0 +1,199 @@
+"""BERT pretraining through the pipeline: data x pipe mesh wiring.
+
+Reference parity: StageRuntime driving the staged BERT model in its
+GPipe-with-flushes loop (BERT/runtime.py:842, main_bert.py:1075), stage
+modules from models/bert/depth=N (SURVEY.md C7/C16). Here the same schedule
+is the ``lax.scan`` pipeline of parallel/pipeline.py over a 2-D
+``Mesh((dp, pp), ("data", "pipe"))``:
+
+- batch sharded over ``data``; transformer layers sharded over ``pipe``
+  (models/bert_staged.py layout: stage_stack [S, ...], shared replicated);
+- each tick's activation hop is a ``ppermute`` along ``pipe``;
+- gradients: stage grads live on their pipe rank and are psum'd over
+  ``data`` (plain DP within a stage, the reference's stage DP groups);
+  shared (embeddings/heads) grads are psum'd over BOTH axes — embedding
+  cotangents materialise only on pipe rank 0 and head cotangents only on
+  the last rank, so the pipe-psum is a gather, not an overcount.
+
+The optimizer step is dense-DP over stage-sharded flat vectors; composing
+the sparse collectives per stage group rides the same seams (the algorithm
+functions only need the ``data`` axis in scope) and is exposed via
+``compressor=``.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from oktopk_tpu.models.bert_staged import StagedBertPretrain
+from oktopk_tpu.parallel.pipeline import gpipe_apply
+from oktopk_tpu.train import losses
+
+
+def _global_pretrain_loss(mlm, nsp, batch, data_axis):
+    """Global weighted pretrain loss across data shards.
+
+    A pmean of per-shard mean losses is NOT the global loss when shards
+    carry different masked-token counts; sum numerators and denominators
+    over the data axis instead (keeps pipeline loss bit-comparable to the
+    single-module oracle)."""
+    import optax
+    mask = (batch["mlm_labels"] >= 0).astype(jnp.float32)
+    safe = jnp.maximum(batch["mlm_labels"], 0)
+    per_tok = optax.softmax_cross_entropy_with_integer_labels(mlm, safe)
+    mlm_num = lax.psum(jnp.sum(per_tok * mask), data_axis)
+    mlm_den = lax.psum(jnp.sum(mask), data_axis)
+    nsp_ce = optax.softmax_cross_entropy_with_integer_labels(
+        nsp, batch["nsp_labels"])
+    nsp_num = lax.psum(jnp.sum(nsp_ce), data_axis)
+    nsp_den = lax.psum(jnp.asarray(nsp_ce.shape[0], jnp.float32), data_axis)
+    return mlm_num / jnp.maximum(mlm_den, 1.0) + nsp_num / nsp_den
+
+
+def make_pipeline_mesh(num_stages: int, devices=None) -> Mesh:
+    """Mesh((dp, pp), ("data", "pipe")) using all (or given) devices."""
+    devices = list(devices if devices is not None else jax.devices())
+    if len(devices) % num_stages != 0:
+        raise ValueError(f"{len(devices)} devices not divisible by "
+                         f"pipeline depth {num_stages}")
+    dp = len(devices) // num_stages
+    arr = np.asarray(devices).reshape(dp, num_stages)
+    return Mesh(arr, ("data", "pipe"))
+
+
+def _microbatch(x, M):
+    return x.reshape((M, x.shape[0] // M) + x.shape[1:])
+
+
+def build_pipeline_loss(staged: StagedBertPretrain, mesh: Mesh,
+                        num_microbatches: int, train: bool = False,
+                        remat: bool = False):
+    """jit ``(stage_stack, shared, batch[, rng]) -> loss`` over the mesh.
+
+    ``batch`` leaves are [global_B, ...] (sharded over ``data``);
+    ``stage_stack`` leaves are [S, ...] (sharded over ``pipe``); ``shared``
+    is replicated. Loss is the replicated global mean.
+    """
+    M = num_microbatches
+
+    def shard_fn(stage_stack, shared, batch, rng):
+        my_stage = jax.tree.map(lambda x: x[0], stage_stack)
+        rngs = None
+        if train:
+            r = jax.random.fold_in(rng, lax.axis_index("data"))
+            rngs = {"dropout": r}
+
+        ids = batch["input_ids"]
+        h0 = staged.embed(shared, ids, batch["token_type_ids"], train,
+                          rngs=rngs)
+        mask_mb = _microbatch(staged.attn_mask(batch["attention_mask"]), M)
+        h0_mb = _microbatch(h0, M)
+
+        def stage_fn(p, x, stage, mb_idx):
+            m = lax.dynamic_index_in_dim(mask_mb, mb_idx, 0, keepdims=False)
+            return staged.apply_stage(p, x, m, train, rngs=rngs)
+
+        outs = gpipe_apply(stage_fn, my_stage, h0_mb, "pipe", M,
+                           remat=remat)
+        h = outs.reshape(ids.shape[0], ids.shape[1], -1)
+        mlm, nsp = staged.head_logits(shared, h, train)
+        return _global_pretrain_loss(mlm, nsp, batch, "data")
+
+    spec_b = P("data")
+    batch_specs = {k: spec_b for k in ("input_ids", "token_type_ids",
+                                       "attention_mask", "mlm_labels",
+                                       "nsp_labels")}
+    mapped = jax.shard_map(
+        shard_fn, mesh=mesh,
+        in_specs=(P("pipe"), P(), batch_specs, P()),
+        out_specs=P())
+    return jax.jit(mapped)
+
+
+def init_pipeline_opt_state(optimizer, stage_stack, shared):
+    """Outer-layout optimizer states: stage moments stacked [S, ...]
+    (vmapped init, shard over ``pipe``), shared moments replicated."""
+    return (jax.vmap(optimizer.init)(stage_stack), optimizer.init(shared))
+
+
+def build_pipeline_train_step(staged: StagedBertPretrain, mesh: Mesh,
+                              num_microbatches: int, optimizer,
+                              remat: bool = False,
+                              grad_clip: Optional[float] = None):
+    """jit ``(stage_stack, shared, opt_states, batch, rng) ->
+    (stage_stack, shared, opt_states, metrics)`` — pipeline fwd/bwd +
+    flush + optimizer step (the reference's run_training_loop_with_flushes
+    + BertAdam.step, BERT/runtime.py:842, transformers/optimization.py:135).
+    ``opt_states`` from :func:`init_pipeline_opt_state`."""
+    M = num_microbatches
+
+    def shard_fn(stage_stack, shared, opt_states, batch, rng):
+        opt_stage_st, opt_shared_st = opt_states
+        my_stage = jax.tree.map(lambda x: x[0], stage_stack)
+        my_opt = jax.tree.map(lambda x: x[0], opt_stage_st)
+        r = jax.random.fold_in(rng, lax.axis_index("data"))
+        rngs = {"dropout": r}
+
+        def loss_fn(my_stage_, shared_):
+            ids = batch["input_ids"]
+            h0 = staged.embed(shared_, ids, batch["token_type_ids"], True,
+                              rngs=rngs)
+            mask_mb = _microbatch(
+                staged.attn_mask(batch["attention_mask"]), M)
+            h0_mb = _microbatch(h0, M)
+
+            def stage_fn(p, x, stage, mb_idx):
+                m = lax.dynamic_index_in_dim(mask_mb, mb_idx, 0,
+                                             keepdims=False)
+                return staged.apply_stage(p, x, m, True, rngs=rngs)
+
+            outs = gpipe_apply(stage_fn, my_stage_, h0_mb, "pipe", M,
+                               remat=remat)
+            h = outs.reshape(ids.shape[0], ids.shape[1], -1)
+            mlm, nsp = staged.head_logits(shared_, h, True)
+            return _global_pretrain_loss(mlm, nsp, batch, "data")
+
+        loss, (g_stage, g_shared) = jax.value_and_grad(
+            loss_fn, argnums=(0, 1))(my_stage, shared)
+        # the loss is already the GLOBAL weighted mean (psum of sums),
+        # so each shard's grads are partial contributions: psum over data
+        # completes them. Shared grads additionally psum over pipe
+        # (embedding cotangents exist only on pipe rank 0, head cotangents
+        # only on the last rank).
+        g_stage = jax.tree.map(lambda g: lax.psum(g, "data"), g_stage)
+        g_shared = jax.tree.map(
+            lambda g: lax.psum(lax.psum(g, "pipe"), "data"), g_shared)
+        if grad_clip is not None:
+            flat = jnp.sqrt(sum(jnp.sum(g ** 2) for g in
+                                jax.tree.leaves((g_stage, g_shared))))
+            scale = jnp.minimum(1.0, grad_clip / (flat + 1e-12))
+            g_stage, g_shared = jax.tree.map(
+                lambda g: g * scale, (g_stage, g_shared))
+
+        upd_s, my_opt = optimizer.update(g_stage, my_opt, my_stage)
+        my_stage = jax.tree.map(jnp.add, my_stage, upd_s)
+        upd_h, opt_shared_st = optimizer.update(g_shared, opt_shared_st,
+                                                shared)
+        shared = jax.tree.map(jnp.add, shared, upd_h)
+
+        stage_stack = jax.tree.map(lambda x: x[None], my_stage)
+        opt_stage_st = jax.tree.map(lambda x: x[None], my_opt)
+        return (stage_stack, shared, (opt_stage_st, opt_shared_st),
+                {"loss": loss})
+
+    spec_b = P("data")
+    batch_specs = {k: spec_b for k in ("input_ids", "token_type_ids",
+                                       "attention_mask", "mlm_labels",
+                                       "nsp_labels")}
+    mapped = jax.shard_map(
+        shard_fn, mesh=mesh,
+        in_specs=(P("pipe"), P(), (P("pipe"), P()), batch_specs, P()),
+        out_specs=(P("pipe"), P(), (P("pipe"), P()), P()))
+    return jax.jit(mapped)

@@ -61,6 +61,28 @@ def _bcast_from_last_bwd(axis_name, _res, ct):
 _bcast_from_last.defvjp(_bcast_from_last_fwd, _bcast_from_last_bwd)
 
 
+def _carry_vma(*arrays, axis_name):
+    """Varying-manual-axes the scan carry must be initialised with under
+    ``shard_map(check_vma=True)``: the union of the inputs' vma plus the
+    pipeline axis (the ppermute output is always varying over it)."""
+    vma = {axis_name}
+    for a in arrays:
+        for leaf in jax.tree.leaves(a):
+            vma |= set(getattr(jax.typeof(leaf), "vma", frozenset()))
+    return tuple(sorted(vma))
+
+
+def _pvary_to(x, vma):
+    missing = tuple(sorted(set(vma)
+                           - set(getattr(jax.typeof(x), "vma",
+                                         frozenset()))))
+    if not missing:
+        return x
+    if hasattr(lax, "pcast"):
+        return lax.pcast(x, missing, to="varying")
+    return lax.pvary(x, missing)
+
+
 def gpipe_apply(stage_fn: Callable, stage_params, microbatches: jnp.ndarray,
                 axis_name: str, num_microbatches: int,
                 remat: bool = False) -> jnp.ndarray:
@@ -69,8 +91,12 @@ def gpipe_apply(stage_fn: Callable, stage_params, microbatches: jnp.ndarray,
     Must be called inside ``shard_map`` with ``axis_name`` in scope.
 
     Args:
-      stage_fn: ``(params, x, stage_index) -> y`` — this rank's stage.
-        ``x`` and ``y`` must have identical shape/dtype.
+      stage_fn: ``(params, x, stage_index, mb_index) -> y`` — this rank's
+        stage. ``x`` and ``y`` must have identical shape/dtype. ``mb_index``
+        is the microbatch this stage is processing this tick, so the stage
+        can index replicated per-microbatch side inputs (attention masks,
+        labels) without them riding the wire — the TPU form of the
+        reference's named inter-stage tensors (BERT/runtime.py:450-458).
       stage_params: this rank's stage parameters (sharded over the axis).
       microbatches: [M, mb, ...] — the full input, replicated; only stage 0
         reads it.
@@ -88,17 +114,20 @@ def gpipe_apply(stage_fn: Callable, stage_params, microbatches: jnp.ndarray,
     fn = jax.checkpoint(stage_fn, static_argnums=()) if remat else stage_fn
 
     x_shape = microbatches.shape[1:]
-    zeros = jnp.zeros(x_shape, microbatches.dtype)
-    outputs = jnp.zeros((M,) + x_shape, microbatches.dtype)
+    vma = _carry_vma(microbatches, stage_params, axis_name=axis_name)
+    zeros = _pvary_to(jnp.zeros(x_shape, microbatches.dtype), vma)
+    outputs = _pvary_to(jnp.zeros((M,) + x_shape, microbatches.dtype), vma)
 
     def tick(carry, t):
         incoming, outputs = carry
         # stage 0 injects microbatch t (while t < M); others take the wire
-        mb_idx = jnp.clip(t, 0, M - 1)
-        inject = lax.dynamic_index_in_dim(microbatches, mb_idx, 0,
+        inject = lax.dynamic_index_in_dim(microbatches,
+                                          jnp.clip(t, 0, M - 1), 0,
                                           keepdims=False)
         x = jnp.where(stage == 0, inject, incoming)
-        y = fn(stage_params, x, stage)
+        # stage s processes microbatch t - s this tick
+        mb_idx = jnp.clip(t - stage, 0, M - 1)
+        y = fn(stage_params, x, stage, mb_idx)
         # last stage banks its result for microbatch t - (P - 1)
         out_idx = jnp.clip(t - (P - 1), 0, M - 1)
         bank = (stage == P - 1) & (t >= P - 1)
@@ -180,7 +209,7 @@ def one_f_one_b(stage_fn: Callable, loss_fn: Callable, stage_params,
         inject = lax.dynamic_index_in_dim(
             microbatches, jnp.clip(m_f, 0, M - 1), 0, keepdims=False)
         x = jnp.where(stage == 0, inject, fwd_wire)
-        y = stage_fn(stage_params, x, stage)
+        y = stage_fn(stage_params, x, stage, jnp.clip(m_f, 0, M - 1))
         slot_f = jnp.mod(m_f, W)
         held = lax.dynamic_index_in_dim(stash, slot_f, 0, keepdims=False)
         stash = lax.dynamic_update_index_in_dim(
@@ -193,7 +222,8 @@ def one_f_one_b(stage_fn: Callable, loss_fn: Callable, stage_params,
         x_b = lax.dynamic_index_in_dim(stash, slot_b, 0, keepdims=False)
         tgt = lax.dynamic_index_in_dim(
             targets, jnp.clip(m_b, 0, M - 1), 0, keepdims=False)
-        y_b, vjp = jax.vjp(lambda p, xx: stage_fn(p, xx, stage),
+        mb_b = jnp.clip(m_b, 0, M - 1)
+        y_b, vjp = jax.vjp(lambda p, xx: stage_fn(p, xx, stage, mb_b),
                            stage_params, x_b)
         l, dldy = jax.value_and_grad(
             lambda yy: loss_fn(yy, tgt))(y_b)
@@ -211,9 +241,13 @@ def one_f_one_b(stage_fn: Callable, loss_fn: Callable, stage_params,
             jnp.where(do_b, ct_in, jnp.zeros_like(ct_in)), axis_name, down)
         return (fwd_wire, bwd_wire, stash, gacc, lacc), None
 
-    init = (zeros_x, zeros_x, jnp.zeros((W,) + x_shape, dtype),
-            jax.tree.map(jnp.zeros_like, stage_params),
-            jnp.zeros((), jnp.float32))
+    vma = _carry_vma(microbatches, stage_params, targets,
+                     axis_name=axis_name)
+    init = (_pvary_to(zeros_x, vma), _pvary_to(zeros_x, vma),
+            _pvary_to(jnp.zeros((W,) + x_shape, dtype), vma),
+            jax.tree.map(lambda p: _pvary_to(jnp.zeros_like(p), vma),
+                         stage_params),
+            _pvary_to(jnp.zeros((), jnp.float32), vma))
     (_, _, _, gacc, lacc), _ = lax.scan(tick, init,
                                         jnp.arange(M + 2 * P - 2))
     loss = lax.psum(lacc, axis_name) / M

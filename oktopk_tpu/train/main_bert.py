@@ -32,6 +32,13 @@ def parse_args(argv=None):
     p.add_argument("--gradient-accumulation-steps", type=int, default=1)
     p.add_argument("--compressor", default="oktopk")
     p.add_argument("--density", type=float, default=0.01)
+    p.add_argument("--pipeline-stages", type=int, default=1,
+                   help="pipeline depth: split the encoder over a "
+                        "data x pipe mesh (reference staged models "
+                        "BERT/bert/models/bert/depth=N + StageRuntime, "
+                        "BERT/runtime.py:842); 1 = pure DP")
+    p.add_argument("--num-microbatches", type=int, default=4,
+                   help="GPipe microbatches per flush when pipelining")
     p.add_argument("--data-dir", default="./data")
     p.add_argument("--seed", type=int, default=42)
     p.add_argument("--fake-devices", type=int, default=0)
@@ -63,6 +70,9 @@ def main(argv=None):
     from oktopk_tpu.data import make_dataset
     from oktopk_tpu.train.trainer import Trainer
     from oktopk_tpu.utils.logging import get_logger
+
+    if args.pipeline_stages > 1:
+        return run_pipeline(args)
 
     num_workers = len(jax.devices())
     cfg = TrainConfig(
@@ -126,6 +136,67 @@ def main(argv=None):
     if args.ckpt_dir and jax.process_index() == 0:
         from oktopk_tpu.train.checkpoint import save_checkpoint
         save_checkpoint(args.ckpt_dir, trainer.state, args.num_minibatches)
+    return 0
+
+
+def run_pipeline(args):
+    """Pipeline-parallel pretraining path: data x pipe mesh, staged encoder
+    (reference StageRuntime GPipe-with-flushes mode, BERT/runtime.py:842)."""
+    import jax
+    import numpy as np
+
+    from oktopk_tpu.models.bert import BertConfig
+    from oktopk_tpu.models.bert_staged import StagedBertPretrain
+    from oktopk_tpu.optim import bert_adam
+    from oktopk_tpu.parallel.bert_pipeline import (
+        build_pipeline_train_step, init_pipeline_opt_state,
+        make_pipeline_mesh)
+    from oktopk_tpu.data import make_dataset
+    from oktopk_tpu.utils.logging import get_logger
+
+    logger = get_logger("oktopk_tpu.bert")
+    cfg = {"bert_base": BertConfig.base, "bert_large": BertConfig.large,
+           "bert_tiny": BertConfig.tiny}[args.model]()
+    staged = StagedBertPretrain(cfg, args.pipeline_stages)
+    mesh = make_pipeline_mesh(args.pipeline_stages)
+    dp = mesh.shape["data"]
+    logger.info("pipeline BERT: %s over mesh data=%d x pipe=%d, M=%d",
+                args.model, dp, args.pipeline_stages, args.num_microbatches)
+
+    params = staged.init(jax.random.PRNGKey(args.seed), 2,
+                         args.max_seq_length)
+    stack, shared = staged.split(params)
+    opt = bert_adam(lr=args.lr, warmup=args.warmup_proportion,
+                    t_total=args.num_minibatches)
+    opt_states = init_pipeline_opt_state(opt, stack, shared)
+    step = build_pipeline_train_step(
+        staged, mesh, num_microbatches=args.num_microbatches, optimizer=opt)
+
+    global_bs = args.batch_size * dp * args.num_microbatches
+    data_iter, meta = make_dataset("wikipedia", args.model, global_bs,
+                                   path=args.data_dir, seed=args.seed)
+    if meta.get("synthetic"):
+        logger.warning("Wikipedia shards not found: synthetic MLM/NSP data")
+
+    rng = jax.random.PRNGKey(args.seed + 1)
+    import time
+    t0 = time.time()
+    for i in range(args.num_minibatches):
+        rng, sub = jax.random.split(rng)
+        stack, shared, opt_states, m = step(stack, shared, opt_states,
+                                            next(data_iter), sub)
+        if (i + 1) % args.log_every == 0:
+            dt = (time.time() - t0) / args.log_every
+            logger.info("iter %d loss %.4f %.3fs/it", i + 1,
+                        float(m["loss"]), dt)
+            t0 = time.time()
+    if args.ckpt_dir and jax.process_index() == 0:
+        from oktopk_tpu.train.checkpoint import save_checkpoint
+        save_checkpoint(args.ckpt_dir,
+                        {"params": staged.merge(stack, shared),
+                         "model_state": {}}, args.num_minibatches)
+        logger.info("saved single-module-layout checkpoint to %s",
+                    args.ckpt_dir)
     return 0
 
 

@@ -1,0 +1,92 @@
+"""Convergence comparison harness: oktopk vs dense vs baselines.
+
+The reference's de-facto correctness standard is accuracy logs over full
+training runs with every algorithm on the same model/data
+(VGG/sbatch_vgg_jobs.sh:1-7, VGG/dl_trainer.py:606-616, and the
+PROFILING_NORM dense-vs-sparse EPS instrumentation,
+VGG/allreducer.py:1072-1080). This is the TPU-native analogue sized for the
+virtual CPU mesh: a learnable teacher-labeled dataset, a few hundred steps,
+losses + comm volumes written as one JSONL per (model, compressor) under
+logs/convergence/.
+
+Usage:
+    python scripts/convergence.py [--steps 300] [--models mnistnet,caffe_cifar]
+        [--compressors oktopk,dense,topkA] [--workers 8] [--out logs/convergence]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def run_one(model: str, compressor: str, steps: int, mesh, density: float,
+            lr: float, out_dir: str, log_every: int = 10,
+            batch_size: int = 8):
+    import numpy as np
+
+    from oktopk_tpu.config import TrainConfig
+    from oktopk_tpu.data.synthetic import teacher_iterator
+    from oktopk_tpu.train.trainer import Trainer
+
+    cfg = TrainConfig(dnn=model, dataset="synthetic-teacher",
+                      batch_size=batch_size, lr=lr, compressor=compressor,
+                      density=density)
+    trainer = Trainer(cfg, mesh=mesh, warmup=False)
+    P = trainer.cfg.num_workers
+    it = teacher_iterator(model, batch_size * P, seed=7)
+
+    path = os.path.join(out_dir, f"{model}_{compressor}.jsonl")
+    t0 = time.time()
+    with open(path, "w") as f:
+        header = {"model": model, "compressor": compressor, "steps": steps,
+                  "workers": P, "density": density, "lr": lr,
+                  "batch_size": batch_size, "n_params": trainer.algo_cfg.n}
+        f.write(json.dumps(header) + "\n")
+        for i in range(steps):
+            m = trainer.train_step(next(it))
+            if (i + 1) % log_every == 0 or i == 0:
+                rec = {"step": i + 1, "loss": float(m["loss"]),
+                       "comm_volume": float(m["comm_volume"])}
+                f.write(json.dumps(rec) + "\n")
+                f.flush()
+    print(f"[convergence] {model}/{compressor}: final loss "
+          f"{float(m['loss']):.4f} ({time.time()-t0:.0f}s) -> {path}",
+          flush=True)
+    return path
+
+
+def main():
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--steps", type=int, default=300)
+    p.add_argument("--models", default="mnistnet,caffe_cifar")
+    p.add_argument("--compressors", default="oktopk,dense,topkA")
+    p.add_argument("--workers", type=int, default=8)
+    p.add_argument("--density", type=float, default=0.05)
+    p.add_argument("--lr", type=float, default=0.02)
+    p.add_argument("--out", default="logs/convergence")
+    args = p.parse_args()
+
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + f" --xla_force_host_platform_device_count={args.workers}")
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+    from oktopk_tpu.comm.mesh import get_mesh
+
+    mesh = get_mesh((args.workers,), ("data",))
+    os.makedirs(args.out, exist_ok=True)
+    for model in args.models.split(","):
+        for comp in args.compressors.split(","):
+            run_one(model, comp, args.steps, mesh, args.density, args.lr,
+                    args.out)
+
+
+if __name__ == "__main__":
+    main()

@@ -73,7 +73,7 @@ class TestGPipe:
         x = jnp.asarray(rng.randn(M, mb, dim).astype(np.float32))
         ws = jnp.asarray(rng.randn(4, dim, dim).astype(np.float32) * 0.3)
 
-        def stage_fn(w, h, stage_idx):
+        def stage_fn(w, h, stage_idx, mb_idx):
             return jnp.tanh(h @ w)
 
         def f(ws_, x_):
@@ -97,7 +97,7 @@ class TestGPipe:
         y = jnp.asarray(rng.randn(M, mb, dim).astype(np.float32))
         ws = jnp.asarray(rng.randn(4, dim, dim).astype(np.float32) * 0.3)
 
-        def stage_fn(w, h, stage_idx):
+        def stage_fn(w, h, stage_idx, mb_idx):
             return jnp.tanh(h @ w)
 
         def loss(ws_, x_, y_):
@@ -136,7 +136,7 @@ class TestGPipe:
         y = jnp.asarray(rng.randn(M, mb, dim).astype(np.float32))
         ws = jnp.asarray(rng.randn(4, dim, dim).astype(np.float32) * 0.3)
 
-        def stage_fn(w, h, stage_idx):
+        def stage_fn(w, h, stage_idx, mb_idx):
             return jnp.tanh(h @ w)
 
         def sq(o, t):
@@ -169,7 +169,7 @@ class TestGPipe:
         x = jnp.asarray(rng.randn(M, mb, dim).astype(np.float32))
         ws = jnp.asarray(rng.randn(4, dim, dim).astype(np.float32) * 0.3)
 
-        def stage_fn(w, h, stage_idx):
+        def stage_fn(w, h, stage_idx, mb_idx):
             return jnp.tanh(h @ w)
 
         def f(remat):
