@@ -82,6 +82,116 @@ def load_mnist(path: str, split: str = "train"):
             "label": y.astype(np.int32)}
 
 
+IMAGENET_MEAN = np.array([0.485, 0.456, 0.406], np.float32)
+IMAGENET_STD = np.array([0.229, 0.224, 0.225], np.float32)
+
+
+def _bilinear_resize(img: np.ndarray, out_h: int, out_w: int) -> np.ndarray:
+    """Vectorised numpy bilinear resize, HWC float32."""
+    h, w = img.shape[:2]
+    if h == out_h and w == out_w:
+        return img
+    ys = (np.arange(out_h, dtype=np.float32) + 0.5) * (h / out_h) - 0.5
+    xs = (np.arange(out_w, dtype=np.float32) + 0.5) * (w / out_w) - 0.5
+    y0 = np.clip(np.floor(ys).astype(np.int32), 0, h - 1)
+    x0 = np.clip(np.floor(xs).astype(np.int32), 0, w - 1)
+    y1 = np.minimum(y0 + 1, h - 1)
+    x1 = np.minimum(x0 + 1, w - 1)
+    wy = np.clip(ys - y0, 0.0, 1.0)[:, None, None]
+    wx = np.clip(xs - x0, 0.0, 1.0)[None, :, None]
+    top = img[y0][:, x0] * (1 - wx) + img[y0][:, x1] * wx
+    bot = img[y1][:, x0] * (1 - wx) + img[y1][:, x1] * wx
+    return top * (1 - wy) + bot * wy
+
+
+def _random_resized_crop(img: np.ndarray, size: int,
+                         rng: np.random.RandomState) -> np.ndarray:
+    """Numpy form of torchvision RandomResizedCrop (scale [0.08, 1],
+    ratio [3/4, 4/3]) used by the reference's ImageNet transform
+    (VGG/dl_trainer.py:274-276)."""
+    h, w = img.shape[:2]
+    area = h * w
+    for _ in range(10):
+        target = area * rng.uniform(0.08, 1.0)
+        ratio = np.exp(rng.uniform(np.log(3 / 4), np.log(4 / 3)))
+        cw = int(round(np.sqrt(target * ratio)))
+        ch = int(round(np.sqrt(target / ratio)))
+        if 0 < cw <= w and 0 < ch <= h:
+            y = rng.randint(0, h - ch + 1)
+            x = rng.randint(0, w - cw + 1)
+            return _bilinear_resize(img[y:y + ch, x:x + cw], size, size)
+    # fallback: center crop of the short side
+    s = min(h, w)
+    y, x = (h - s) // 2, (w - s) // 2
+    return _bilinear_resize(img[y:y + s, x:x + s], size, size)
+
+
+def _center_crop(img: np.ndarray, size: int) -> np.ndarray:
+    h, w = img.shape[:2]
+    s = min(h, w)
+    y, x = (h - s) // 2, (w - s) // 2
+    return _bilinear_resize(img[y:y + s, x:x + s], size, size)
+
+
+def imagenet_hdf5_iterator(h5path: str, batch_size: int,
+                           split: str = "train", seed: int = 0,
+                           image_size: int = 224,
+                           chunk_batches: int = 16):
+    """Streaming ImageNet batches from the reference's HDF5 layout
+    (``imagenet-shuffled.hdf5`` with ``{split}_img`` [N, H, W, C] uint8 and
+    ``{split}_labels`` [N] — VGG/datasets.py:8-36, VGG/dl_trainer.py:262).
+
+    TPU-first IO shape: the reference reads one image per __getitem__
+    through DataLoader worker processes — random single-index HDF5 reads
+    that thrash the chunk cache. Here a *contiguous* slab of
+    ``chunk_batches * batch_size`` images is read per HDF5 access (the file
+    is pre-shuffled, hence its name) and augmentation
+    (RandomResizedCrop + horizontal flip + ImageNet normalise, matching the
+    reference's torchvision transform) runs vectorised in numpy.
+    Yields {"image": [B, size, size, 3] f32 NHWC, "label": [B] i32}.
+    """
+    import h5py
+
+    def gen():
+        rng = np.random.RandomState(seed)
+        with h5py.File(h5path, "r", libver="latest", swmr=True) as hf:
+            imgs = hf[f"{split}_img"]
+            labels = np.asarray(hf[f"{split}_labels"]).astype(np.int32)
+            n = imgs.shape[0]
+            slab = max(batch_size, chunk_batches * batch_size)
+            train = split == "train"
+            while True:
+                starts = np.arange(0, n - batch_size + 1, slab)
+                if train:
+                    rng.shuffle(starts)
+                for s0 in starts:
+                    hi = min(n, s0 + slab)
+                    raw = np.asarray(imgs[s0:hi])
+                    order = (rng.permutation(hi - s0) if train
+                             else np.arange(hi - s0))
+                    for b0 in range(0, hi - s0 - batch_size + 1, batch_size):
+                        sel = order[b0:b0 + batch_size]
+                        out = np.empty(
+                            (batch_size, image_size, image_size, 3),
+                            np.float32)
+                        for j, idx in enumerate(sel):
+                            im = raw[idx].astype(np.float32) / 255.0
+                            if im.ndim == 2:
+                                im = np.repeat(im[:, :, None], 3, axis=2)
+                            if train:
+                                im = _random_resized_crop(im, image_size,
+                                                          rng)
+                                if rng.rand() < 0.5:
+                                    im = im[:, ::-1]
+                            else:
+                                im = _center_crop(im, image_size)
+                            out[j] = (im - IMAGENET_MEAN) / IMAGENET_STD
+                        yield {"image": out,
+                               "label": labels[s0 + sel]}
+
+    return gen()
+
+
 def load_ptb(path: str, split: str = "train", num_steps: int = 35):
     """Word-level PTB (reference VGG/ptb_reader.py:32 builds the vocab from
     ptb.train.txt and id-izes each split)."""
@@ -132,6 +242,17 @@ def make_dataset(dataset: str, dnn: str, batch_size: int,
             return (pretrain_iterator(corpus, tok, batch_size, seq,
                                       seed, vocab_size),
                     {"synthetic": False, "num_examples": 50000})
+        if dataset == "imagenet":
+            h5path = os.path.join(path, "imagenet-shuffled.hdf5")
+            if not os.path.exists(h5path):
+                raise FileNotFoundError(h5path)
+            import h5py
+            with h5py.File(h5path, "r") as hf:
+                key = "train_img" if split == "train" else "val_img"
+                num = int(hf[key].shape[0])
+            it = imagenet_hdf5_iterator(h5path, batch_size, split=split,
+                                        seed=seed)
+            return it, {"synthetic": False, "num_examples": num}
         if dataset == "an4":
             from oktopk_tpu.data.audio import an4_iterator
             manifest = os.path.join(

@@ -94,6 +94,75 @@ class TestAudio:
         assert int(b["label_lengths"][0]) == 11
 
 
+class TestImagenetHDF5:
+    """Reference HDF5 layout: imagenet-shuffled.hdf5 with {split}_img
+    [N, H, W, C] uint8 + {split}_labels [N] (VGG/datasets.py:8-36)."""
+
+    @pytest.fixture(scope="class")
+    def h5dir(self, tmp_path_factory):
+        h5py = pytest.importorskip("h5py")
+        d = tmp_path_factory.mktemp("imagenet")
+        rng = np.random.RandomState(0)
+        with h5py.File(d / "imagenet-shuffled.hdf5", "w") as hf:
+            hf["train_img"] = rng.randint(0, 256, size=(12, 48, 56, 3),
+                                          dtype=np.uint8)
+            hf["train_labels"] = rng.randint(0, 1000, size=(12,))
+            hf["val_img"] = rng.randint(0, 256, size=(6, 48, 56, 3),
+                                        dtype=np.uint8)
+            hf["val_labels"] = rng.randint(0, 1000, size=(6,))
+        return str(d)
+
+    def test_train_batches(self, h5dir):
+        from oktopk_tpu.data.loaders import make_dataset
+        it, meta = make_dataset("imagenet", "resnet50", 4, path=h5dir,
+                                seed=3)
+        assert meta == {"synthetic": False, "num_examples": 12}
+        b = next(it)
+        assert b["image"].shape == (4, 224, 224, 3)
+        assert b["image"].dtype == np.float32
+        assert b["label"].shape == (4,) and b["label"].dtype == np.int32
+        # ImageNet-normalised pixels land in a few-sigma range
+        assert np.abs(b["image"]).max() < 4.0
+        assert np.isfinite(b["image"]).all()
+
+    def test_val_is_deterministic(self, h5dir):
+        from oktopk_tpu.data.loaders import imagenet_hdf5_iterator
+        p = f"{h5dir}/imagenet-shuffled.hdf5"
+        a = next(imagenet_hdf5_iterator(p, 4, split="val", seed=1))
+        b = next(imagenet_hdf5_iterator(p, 4, split="val", seed=2))
+        np.testing.assert_array_equal(a["image"], b["image"])
+        np.testing.assert_array_equal(a["label"], b["label"])
+
+    def test_labels_follow_images(self, h5dir):
+        """Augmentation must not decouple labels from their images: val
+        split (no shuffle, center crop) preserves file order."""
+        import h5py
+        from oktopk_tpu.data.loaders import imagenet_hdf5_iterator
+        p = f"{h5dir}/imagenet-shuffled.hdf5"
+        with h5py.File(p, "r") as hf:
+            want = np.asarray(hf["val_labels"][:4]).astype(np.int32)
+        b = next(imagenet_hdf5_iterator(p, 4, split="val", seed=0))
+        np.testing.assert_array_equal(b["label"], want)
+
+    def test_missing_file_falls_back_synthetic(self, tmp_path):
+        from oktopk_tpu.data.loaders import make_dataset
+        it, meta = make_dataset("imagenet", "resnet50", 2,
+                                path=str(tmp_path))
+        assert meta["synthetic"] is True
+        b = next(it)
+        assert b["image"].shape == (2, 224, 224, 3)
+
+    def test_resize_bilinear_identity(self):
+        from oktopk_tpu.data.loaders import _bilinear_resize
+        img = np.random.RandomState(0).rand(16, 16, 3).astype(np.float32)
+        np.testing.assert_array_equal(_bilinear_resize(img, 16, 16), img)
+        up = _bilinear_resize(img, 32, 32)
+        assert up.shape == (32, 32, 3)
+        # bilinear stays inside the source value range
+        assert up.min() >= img.min() - 1e-6
+        assert up.max() <= img.max() + 1e-6
+
+
 class TestNewZooModels:
     @pytest.mark.parametrize("dnn", ["densenet100", "preresnet110",
                                      "resnext29", "caffe_cifar"])
