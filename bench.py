@@ -107,10 +107,13 @@ def step_time_probe(iters=10):
 
     out = {"device": dev.platform}
     flops_per_step = None
-    for comp in ("dense", "oktopk"):
+    # oktopk_b4 = 4 reverse-layer-order buckets (comm/backward overlap,
+    # reference VGG/allreducer.py:27) — the delta vs single-bucket oktopk
+    # is the measured overlap benefit
+    for comp, buckets in (("dense", 1), ("oktopk", 1), ("oktopk_b4", 4)):
         cfg = TrainConfig(dnn="vgg16", dataset="cifar10", batch_size=16,
-                          lr=0.1, compressor=comp, density=0.02,
-                          num_workers=1)
+                          lr=0.1, compressor=comp.split("_")[0],
+                          density=0.02, num_workers=1, num_buckets=buckets)
         trainer = Trainer(cfg, mesh=mesh, warmup=False)
         _ = _time_steps(trainer, batch, 2)        # compile + warm
         times = _time_steps(trainer, batch, iters)
@@ -186,7 +189,8 @@ def main():
         "vs_baseline": round(dense / value, 2),
     }
     for key in ("device", "oktopk_ms", "oktopk_ms_std", "dense_ms",
-                "dense_ms_std", "flops_per_step", "peak_flops_assumed",
+                "dense_ms_std", "oktopk_b4_ms", "oktopk_b4_ms_std",
+                "flops_per_step", "peak_flops_assumed",
                 "mfu_dense", "mfu_oktopk"):
         if key in steps:
             record[key] = (round(steps[key], 3)

@@ -197,6 +197,10 @@ class TrainConfig:
     # BERT-style warmup-linear schedule knobs (transformers/optimization.py).
     warmup_proportion: float = 0.01
     total_steps: int = 0
+    # Comm/backward overlap: number of reverse-layer-order gradient buckets,
+    # each with its own sparse collective + SparseState (reference <=640 MiB
+    # bucketing, VGG/allreducer.py:27,272-330). 1 = whole-model flat.
+    num_buckets: int = 1
 
     def experiment_slug(self) -> str:
         """Reference experiment naming convention

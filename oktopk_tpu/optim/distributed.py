@@ -5,11 +5,11 @@ This replaces the reference's entire L3/L4 concurrency machinery
 (VGG/distributed_optimizer.py:63-94), the background allreducer thread and
 its two-queue handshake (VGG/allreducer.py:549, :1640-1643), and the
 ``synchronize()`` join (:96-105). Under XLA all of that is one traced
-program: backward, flatten (``ravel_pytree`` — the analogue of the
-reference's reverse-layer-order bucket merge, VGG/allreducer.py:272-330,
-except the whole model is one bucket like the BERT variant's "myallreduce"
-flat tensor, BERT/bert/allreducer.py:200), sparse collective, unflatten,
-optimizer update. Compute/communication overlap is XLA's async collective
+program: backward, reverse-layer-order bucket flatten (the analogue of the
+reference's bucket merge, VGG/allreducer.py:272-330; with ``num_buckets=1``
+the whole model is one bucket like the BERT variant's "myallreduce" flat
+tensor, BERT/bert/allreducer.py:200), one sparse collective per bucket,
+unflatten, optimizer update. Compute/communication overlap is XLA's async collective
 scheduling instead of Python threads.
 
 Local gradient accumulation (``nsteps_update``, reference
@@ -26,7 +26,6 @@ import flax.struct
 import jax
 import jax.numpy as jnp
 from jax import lax
-from jax.flatten_util import ravel_pytree
 from jax.sharding import Mesh, PartitionSpec as P
 
 from oktopk_tpu.collectives.registry import get_algorithm
@@ -50,20 +49,71 @@ def flat_size(params) -> int:
     return int(sum(x.size for x in jax.tree.leaves(params)))
 
 
+def bucket_partition(params, num_buckets: int):
+    """Contiguous leaf-index buckets in REVERSE flattened order,
+    greedily balanced by element count.
+
+    Reference semantics: the allreducer consumes layer grads in reverse
+    layer order as backward produces them and merges them into <=640 MiB
+    buckets (VGG/allreducer.py:27,272-330) — bucket 0 holds the LAST
+    layers, whose grads are ready first, so its collective can overlap the
+    remaining backward (under XLA: independent collectives schedule
+    against compute).
+
+    Returns a list of leaf-index lists (ascending within each bucket).
+    """
+    sizes = [x.size for x in jax.tree.leaves(params)]
+    total = sum(sizes)
+    L = len(sizes)
+    num_buckets = max(1, min(num_buckets, L))
+    target = total / num_buckets
+    buckets, cur, acc = [], [], 0
+    for pos, i in enumerate(reversed(range(L))):   # last layers first
+        cur.append(i)
+        acc += sizes[i]
+        leaves_left = L - pos - 1
+        still_needed = num_buckets - len(buckets) - 1
+        if len(buckets) < num_buckets - 1 and (
+                acc >= target - 1e-9            # fair share reached, or
+                or leaves_left == still_needed  # must close to keep every
+        ):                                      # later bucket non-empty
+            buckets.append(sorted(cur))
+            cur, acc = [], 0
+    buckets.append(sorted(cur))
+    assert len(buckets) == num_buckets and all(buckets), buckets
+    return buckets
+
+
+def bucket_sizes(params, buckets):
+    sizes = [x.size for x in jax.tree.leaves(params)]
+    return [int(sum(sizes[i] for i in b)) for b in buckets]
+
+
 def init_dist_state(params, model_state, optimizer, cfg: OkTopkConfig,
                     dtype=jnp.float32,
                     momentum_correction: bool = False,
-                    opt_state: Any = None) -> DistTrainState:
+                    opt_state: Any = None,
+                    num_buckets: int = 1) -> DistTrainState:
     """``momentum_correction`` must be truthy iff the step builder gets a
     nonzero ``momentum_correction`` factor — the shard_map specs key off the
     presence of ``local_momentum``. Pass ``opt_state`` to carry over existing
     optimizer state (e.g. across an elastic resize) instead of allocating a
-    fresh one."""
-    s = init_state(cfg, dtype)
-    s = jax.tree.map(
-        lambda x: jnp.broadcast_to(x, (cfg.num_workers,) + x.shape), s)
-    mom = (jnp.zeros((cfg.num_workers, cfg.n), dtype)
-           if momentum_correction else None)
+    fresh one. With ``num_buckets > 1`` the sparse state (and momentum) is a
+    tuple of per-bucket states matching :func:`bucket_partition`."""
+    def batched(n_b):
+        s = init_state(cfg.replace(n=n_b), dtype)
+        return jax.tree.map(
+            lambda x: jnp.broadcast_to(x, (cfg.num_workers,) + x.shape), s)
+
+    if num_buckets > 1:
+        nbs = bucket_sizes(params, bucket_partition(params, num_buckets))
+        s = tuple(batched(n_b) for n_b in nbs)
+        mom = (tuple(jnp.zeros((cfg.num_workers, n_b), dtype)
+                     for n_b in nbs) if momentum_correction else None)
+    else:
+        s = batched(cfg.n)
+        mom = (jnp.zeros((cfg.num_workers, cfg.n), dtype)
+               if momentum_correction else None)
     return DistTrainState(params=params, model_state=model_state,
                           opt_state=(optimizer.init(params)
                                      if opt_state is None else opt_state),
@@ -82,6 +132,7 @@ def build_sparse_grad_step(
     warmup: bool = True,
     profile_norm: bool = False,
     momentum_correction: float = 0.0,
+    num_buckets: int = 1,
 ):
     """Build the jitted distributed train step.
 
@@ -103,6 +154,12 @@ def build_sparse_grad_step(
         option, VGG/distributed_optimizer.py:56,81-88). The optimizer should
         then be momentum-free SGD, since momentum is already folded into the
         compressed gradient stream.
+      num_buckets: > 1 runs one sparse collective per reverse-layer-order
+        bucket (reference <=640 MiB bucketing, VGG/allreducer.py:27,
+        272-330) with per-bucket SparseState — bucket 0 depends only on
+        the last layers' grads, so XLA can overlap its collective with the
+        remaining backward. Selection becomes per-bucket top-k, exactly
+        the reference's per-merged-group compression.
 
     Returns ``step(state: DistTrainState, batch, rng) -> (state, metrics)``.
     ``batch`` leaves are [num_workers * nsteps_update * mb, ...] and get
@@ -113,7 +170,6 @@ def build_sparse_grad_step(
     algo = get_algorithm(compressor, warmup=warmup)
 
     def shard_fn(state: DistTrainState, batch, rng):
-        sparse = jax.tree.map(lambda x: x[0], state.sparse_state)
         rng = jax.random.fold_in(rng, lax.axis_index(axis_name))
 
         # --- local grads, with optional microbatch accumulation ---
@@ -143,18 +199,57 @@ def build_sparse_grad_step(
             scale = jnp.minimum(1.0, grad_clip / (gnorm + 1e-12))
             grads = jax.tree.map(lambda g: g * scale, grads)
 
-        # --- sparse allreduce of the flat gradient ---
-        flat, unravel = ravel_pytree(grads)
-        assert flat.size == cfg.n, (
-            f"cfg.n={cfg.n} != flat grad size {flat.size}")
+        # --- sparse allreduce of the gradient: one collective per
+        # reverse-layer-order bucket. num_buckets == 1 degenerates to the
+        # whole model as a single flat vector (the BERT variant's
+        # "myallreduce" form, BERT/bert/allreducer.py:200); the outer state
+        # layout stays a bare SparseState in that case for checkpoint
+        # compatibility. ---
+        buckets = bucket_partition(grads, num_buckets)  # static sizes
+        leaves, treedef = jax.tree.flatten(grads)
+        assert sum(x.size for x in leaves) == cfg.n, (
+            f"cfg.n={cfg.n} != flat grad size "
+            f"{sum(x.size for x in leaves)}")
+        single = num_buckets <= 1
+        states_in = ([state.sparse_state] if single
+                     else list(state.sparse_state))
+        moms_in = (([state.local_momentum] if single
+                    else list(state.local_momentum))
+                   if momentum_correction else None)
+        results = [None] * len(leaves)
+        new_sparse, new_moms = [], []
+        vol = lk = gk = jnp.asarray(0.0, jnp.float32)
+        eps_num = eps_den = jnp.asarray(0.0, jnp.float32)
+        for bi, idxs in enumerate(buckets):
+            flat = jnp.concatenate([leaves[i].reshape(-1) for i in idxs])
+            cfg_b = cfg if single else cfg.replace(n=int(flat.size))
+            sp = jax.tree.map(lambda x: x[0], states_in[bi])
+            if momentum_correction:
+                flat = momentum_correction * moms_in[bi][0] + flat
+                new_moms.append(flat[None])
+            reduced, sp = algo(flat, sp, cfg_b, axis_name)
+            off = 0
+            for i in idxs:
+                sz = leaves[i].size
+                results[i] = reduced[off:off + sz].reshape(leaves[i].shape)
+                off += sz
+            new_sparse.append(jax.tree.map(lambda x: x[None], sp))
+            vol = vol + sp.last_volume
+            lk = lk + sp.last_local_count
+            gk = gk + sp.last_global_count
+            if profile_norm:
+                dense = lax.pmean(flat, axis_name)
+                eps_num = eps_num + jnp.sum((dense - reduced) ** 2)
+                eps_den = eps_den + jnp.sum(dense ** 2)
+        grads = jax.tree.unflatten(treedef, results)
+        sparse_out = new_sparse[0] if single else tuple(new_sparse)
         if momentum_correction:
-            mom = momentum_correction * state.local_momentum[0] + flat
-            flat = mom
-            new_momentum = mom[None]
+            new_momentum = new_moms[0] if single else tuple(new_moms)
         else:
             new_momentum = state.local_momentum
-        reduced, sparse = algo(flat, sparse, cfg, axis_name)
-        grads = unravel(reduced)
+        grad_norm = jnp.sqrt(sum(jnp.sum(r ** 2) for r in results))
+        eps = (jnp.sqrt(eps_num) / (jnp.sqrt(eps_den) + 1e-12)
+               if profile_norm else None)
 
         # --- optimizer update (identical on every worker) ---
         updates, opt_state = optimizer.update(grads, state.opt_state,
@@ -163,19 +258,16 @@ def build_sparse_grad_step(
 
         metrics = {
             "loss": lax.pmean(loss, axis_name),
-            "grad_norm": jnp.linalg.norm(reduced),
-            "comm_volume": sparse.last_volume,
-            "local_k": sparse.last_local_count,
-            "global_k": sparse.last_global_count,
+            "grad_norm": grad_norm,
+            "comm_volume": vol,
+            "local_k": lk,
+            "global_k": gk,
         }
-        if profile_norm:
-            dense = lax.pmean(flat, axis_name)
-            metrics["eps_vs_dense"] = (
-                jnp.linalg.norm(dense - reduced)
-                / (jnp.linalg.norm(dense) + 1e-12))
+        if eps is not None:
+            metrics["eps_vs_dense"] = eps
         new_state = DistTrainState(
             params=params, model_state=model_state, opt_state=opt_state,
-            sparse_state=jax.tree.map(lambda x: x[None], sparse),
+            sparse_state=sparse_out,
             local_momentum=new_momentum)
         return new_state, metrics
 

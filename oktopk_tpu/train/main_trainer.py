@@ -34,6 +34,10 @@ def parse_args(argv=None):
     p.add_argument("--max-iters", type=int, default=0,
                    help="if set, run exactly this many iterations")
     p.add_argument("--nsteps-update", type=int, default=1)
+    p.add_argument("--num-buckets", type=int, default=1,
+                   help="reverse-layer-order gradient buckets, one sparse "
+                        "collective each (reference <=640MiB bucketing, "
+                        "VGG/allreducer.py:27); 1 = whole-model flat")
     p.add_argument("--compressor", default="oktopk")
     p.add_argument("--density", type=float, default=0.02)
     p.add_argument("--sigma-scale", type=float, default=2.5)
@@ -96,6 +100,7 @@ def main(argv=None):
         lr=args.lr, momentum=args.momentum, weight_decay=args.weight_decay,
         nesterov=args.nesterov, max_epochs=args.max_epochs,
         nsteps_update=args.nsteps_update, compressor=args.compressor,
+        num_buckets=args.num_buckets,
         density=args.density, sigma_scale=args.sigma_scale,
         grad_clip=args.grad_clip, seed=args.seed,
         num_workers=len(jax.devices()))

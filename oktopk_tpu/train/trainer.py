@@ -102,7 +102,8 @@ class Trainer:
         self._profile_norm = profile_norm
         self.state = init_dist_state(
             params, self.model_state, self.optimizer, self.algo_cfg,
-            momentum_correction=bool(self._mc_factor))
+            momentum_correction=bool(self._mc_factor),
+            num_buckets=cfg.num_buckets)
         self.step_fn = self._build_step()
         self._rng = jax.random.PRNGKey(cfg.seed + 1)
         self.metrics_history = []
@@ -114,7 +115,8 @@ class Trainer:
             nsteps_update=self.cfg.nsteps_update,
             grad_clip=self.cfg.grad_clip, warmup=self._warmup,
             profile_norm=self._profile_norm,
-            momentum_correction=self._mc_factor)
+            momentum_correction=self._mc_factor,
+            num_buckets=self.cfg.num_buckets)
 
     # ---- workload-specific pieces -------------------------------------
 
@@ -282,7 +284,8 @@ class Trainer:
             (self.state.params, self.state.model_state, self.state.opt_state))
         self.state = init_dist_state(
             old[0], old[1], self.optimizer, self.algo_cfg,
-            momentum_correction=bool(self._mc_factor), opt_state=old[2])
+            momentum_correction=bool(self._mc_factor), opt_state=old[2],
+            num_buckets=self.cfg.num_buckets)
         self.step_fn = self._build_step()
 
     # ---- eval ---------------------------------------------------------
