@@ -1,0 +1,171 @@
+"""Sequence-parallel BERT: long-context pretraining over a ``seq`` mesh axis.
+
+The reference has no sequence/context parallelism at all — max_seq_length is
+a plain flag and attention is vanilla quadratic BertSelfAttention
+(SURVEY.md §5.7); on TPU the sequence is a first-class scaling axis. Here
+the WHOLE BertForPreTraining forward runs with the token dimension sharded:
+
+- embeddings per shard (position ids offset by ``shard * T_local``);
+- every layer's attention is exact ring attention
+  (parallel/ring_attention.py): K/V blocks rotate over ICI ``ppermute``
+  hops, online-softmax accumulation, no [T, T] materialisation — activation
+  memory per chip scales as T/P;
+- LayerNorm/MLP/heads are position-local; the pooler's [CLS] vector lives
+  on shard 0 and is replicated with one tiny psum;
+- the MLM loss is the global weighted mean (psum of numerator/denominator
+  over the seq axis).
+
+The math consumes the *unchanged* ``BertForPreTraining`` parameter tree
+(models/bert.py) — flax module layout re-expressed functionally — so
+sequence-parallel loss is equivalence-testable against the single-module
+oracle to float tolerance, and checkpoints interchange. Composes with data
+parallelism by adding a leading ``data`` axis to the mesh (batch sharded
+over ``data``, tokens over ``seq``).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from oktopk_tpu.models.bert import BertConfig
+from oktopk_tpu.parallel.ring_attention import ring_attention
+from oktopk_tpu.train import losses  # noqa: F401  (doc cross-ref)
+
+
+def _layer_norm(p, x, eps):
+    mu = jnp.mean(x, axis=-1, keepdims=True)
+    var = jnp.var(x, axis=-1, keepdims=True)
+    return (x - mu) * lax.rsqrt(var + eps) * p["scale"] + p["bias"]
+
+
+def _dense(p, x):
+    return jnp.einsum("...e,ef->...f", x, p["kernel"]) + p["bias"]
+
+
+def _mha(p, x, kv_mask, axis_name):
+    """flax MultiHeadDotProductAttention math with ring attention inside.
+
+    p: the module's params — query/key/value kernels [E, H, D] (+bias
+    [H, D]), out kernel [H, D, E] (+bias [E])."""
+    def proj(pp):
+        return jnp.einsum("bte,ehd->bthd", x, pp["kernel"]) + pp["bias"]
+
+    o = ring_attention(proj(p["query"]), proj(p["key"]), proj(p["value"]),
+                       axis_name, kv_mask=kv_mask)
+    return jnp.einsum("bthd,hde->bte", o, p["out"]["kernel"]) \
+        + p["out"]["bias"]
+
+
+def _layer(p, x, kv_mask, cfg: BertConfig, axis_name):
+    y = _mha(p["attention"], x, kv_mask, axis_name)
+    x = _layer_norm(p["attention_ln"], x + y, cfg.layer_norm_eps)
+    h = _dense(p["intermediate"], x)
+    h = jax.nn.gelu(h, approximate=False)
+    h = _dense(p["output"], h)
+    return _layer_norm(p["output_ln"], x + h, cfg.layer_norm_eps)
+
+
+def bert_seq_forward(params, input_ids, token_type_ids, attention_mask,
+                     cfg: BertConfig, axis_name: str = "seq"):
+    """Sequence-sharded BertForPreTraining forward (deterministic).
+
+    Shards: ``input_ids``/``token_type_ids``/``attention_mask`` are the
+    LOCAL [B, T/P] token slices. Returns (mlm_logits [B, T/P, V] local,
+    nsp_logits [B, 2] replicated).
+    """
+    shard = lax.axis_index(axis_name)
+    B, Tl = input_ids.shape
+    emb = params["bert"]["embeddings"]
+    positions = shard * Tl + jnp.arange(Tl)[None, :]
+    x = (emb["word_embeddings"]["embedding"][input_ids]
+         + emb["position_embeddings"]["embedding"][positions]
+         + emb["token_type_embeddings"]["embedding"][token_type_ids])
+    x = _layer_norm(emb["LayerNorm_0"], x, cfg.layer_norm_eps)
+
+    kv_mask = attention_mask.astype(bool)
+    enc = params["bert"]["encoder"]
+    for i in range(cfg.num_layers):
+        x = _layer(enc[f"layer_{i}"], x, kv_mask, cfg, axis_name)
+
+    # pooler input: the global [CLS] (= position 0) lives on shard 0
+    cls = jnp.where(shard == 0, x[:, 0], jnp.zeros_like(x[:, 0]))
+    cls = lax.psum(cls, axis_name)
+    pooled = jnp.tanh(_dense(params["bert"]["pooler"], cls))
+
+    h = _dense(params["mlm_dense"], x)
+    h = jax.nn.gelu(h, approximate=False)
+    h = _layer_norm(params["mlm_ln"], h, cfg.layer_norm_eps)
+    table = emb["word_embeddings"]["embedding"]
+    mlm = jnp.einsum("bth,vh->btv", h, table.astype(cfg.dtype))
+    mlm = mlm + params["mlm_bias"]
+    nsp = _dense(params["nsp"], pooled)
+    return mlm.astype(jnp.float32), nsp.astype(jnp.float32)
+
+
+def bert_seq_loss(params, batch, cfg: BertConfig, axis_name: str = "seq"):
+    """Global MLM+NSP loss from local shards (inside shard_map)."""
+    import optax
+    mlm, nsp = bert_seq_forward(params, batch["input_ids"],
+                                batch["token_type_ids"],
+                                batch["attention_mask"], cfg, axis_name)
+    mask = (batch["mlm_labels"] >= 0).astype(jnp.float32)
+    safe = jnp.maximum(batch["mlm_labels"], 0)
+    per_tok = optax.softmax_cross_entropy_with_integer_labels(mlm, safe)
+    num = lax.psum(jnp.sum(per_tok * mask), axis_name)
+    den = lax.psum(jnp.sum(mask), axis_name)
+    nsp_loss = optax.softmax_cross_entropy_with_integer_labels(
+        nsp, batch["nsp_labels"]).mean()
+    return num / jnp.maximum(den, 1.0) + nsp_loss
+
+
+def make_seq_mesh(num_shards: int, devices=None) -> Mesh:
+    import numpy as np
+    devices = list(devices if devices is not None else jax.devices())
+    return Mesh(np.asarray(devices[:num_shards]), ("seq",))
+
+
+def build_seq_train_step(cfg: BertConfig, mesh: Mesh, optimizer,
+                         axis_name: str = "seq"):
+    """jit ``(params, opt_state, batch) -> (params, opt_state, loss)``.
+
+    Gradients flow through the shard_map'd loss (ppermute/psum transposes
+    are exact under VMA tracking — pinned by
+    tests/test_bert_seq.py::test_gradients_match_single_module); params are
+    replicated, so the optimizer step runs outside the mesh program.
+    Deterministic forward (no dropout) — the long-context regime this path
+    exists for pretrains with dropout disabled anyway.
+    """
+    loss_fn = build_seq_loss(cfg, mesh, axis_name)
+
+    @jax.jit
+    def step(params, opt_state, batch):
+        loss, grads = jax.value_and_grad(
+            lambda p: loss_fn(p, batch))(params)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = jax.tree.map(jnp.add, params, updates)
+        return params, opt_state, loss
+
+    return step
+
+
+def build_seq_loss(cfg: BertConfig, mesh: Mesh,
+                   axis_name: str = "seq"):
+    """jit ``(params, batch) -> loss`` with batch token dims sharded over
+    ``seq``. ``nsp_labels`` is replicated; everything else [B, T] splits on
+    the token axis."""
+    tok_spec = P(None, axis_name)
+    batch_specs = {"input_ids": tok_spec, "token_type_ids": tok_spec,
+                   "attention_mask": tok_spec, "mlm_labels": tok_spec,
+                   "nsp_labels": P()}
+
+    def shard_fn(params, batch):
+        return bert_seq_loss(params, batch, cfg, axis_name)
+
+    mapped = jax.shard_map(shard_fn, mesh=mesh,
+                           in_specs=(P(), batch_specs), out_specs=P())
+    return jax.jit(mapped)

@@ -39,6 +39,11 @@ def parse_args(argv=None):
                         "BERT/runtime.py:842); 1 = pure DP")
     p.add_argument("--num-microbatches", type=int, default=4,
                    help="GPipe microbatches per flush when pipelining")
+    p.add_argument("--seq-shards", type=int, default=1,
+                   help="sequence/context parallelism: shard the token "
+                        "axis over a seq mesh with ring attention "
+                        "(long-context extension; the reference has none, "
+                        "SURVEY.md 5.7); 1 = off")
     p.add_argument("--data-dir", default="./data")
     p.add_argument("--seed", type=int, default=42)
     p.add_argument("--fake-devices", type=int, default=0)
@@ -73,6 +78,8 @@ def main(argv=None):
 
     if args.pipeline_stages > 1:
         return run_pipeline(args)
+    if args.seq_shards > 1:
+        return run_seq_parallel(args)
 
     num_workers = len(jax.devices())
     cfg = TrainConfig(
@@ -197,6 +204,62 @@ def run_pipeline(args):
                          "model_state": {}}, args.num_minibatches)
         logger.info("saved single-module-layout checkpoint to %s",
                     args.ckpt_dir)
+    return 0
+
+
+def run_seq_parallel(args):
+    """Sequence-parallel pretraining: token axis sharded over a seq mesh
+    with ring attention (long-context path; see parallel/bert_seq.py)."""
+    import time
+
+    import jax
+
+    from oktopk_tpu.data import make_dataset
+    from oktopk_tpu.models.bert import BertConfig, BertForPreTraining
+    from oktopk_tpu.optim import bert_adam
+    from oktopk_tpu.parallel.bert_seq import (build_seq_train_step,
+                                              make_seq_mesh)
+    from oktopk_tpu.utils.logging import get_logger
+    import jax.numpy as jnp
+
+    logger = get_logger("oktopk_tpu.bert")
+    cfg = {"bert_base": BertConfig.base, "bert_large": BertConfig.large,
+           "bert_tiny": BertConfig.tiny}[args.model]()
+    if args.max_seq_length % args.seq_shards:
+        raise SystemExit("--max-seq-length must divide by --seq-shards")
+    mesh = make_seq_mesh(args.seq_shards)
+    logger.info("seq-parallel BERT: %s, T=%d over %d shards "
+                "(T/P=%d per chip)", args.model, args.max_seq_length,
+                args.seq_shards, args.max_seq_length // args.seq_shards)
+
+    ex = jnp.zeros((2, args.max_seq_length), jnp.int32)
+    rng = jax.random.PRNGKey(args.seed)
+    params = BertForPreTraining(cfg).init(
+        {"params": rng, "dropout": rng}, ex, ex, jnp.ones_like(ex),
+        train=False)["params"]
+    opt = bert_adam(lr=args.lr, warmup=args.warmup_proportion,
+                    t_total=args.num_minibatches)
+    opt_state = opt.init(params)
+    step = build_seq_train_step(cfg, mesh, opt)
+
+    data_iter, meta = make_dataset("wikipedia", args.model, args.batch_size,
+                                   path=args.data_dir, seed=args.seed)
+    if meta.get("synthetic"):
+        logger.warning("Wikipedia shards not found: synthetic MLM/NSP data")
+
+    t0 = time.time()
+    for i in range(args.num_minibatches):
+        params, opt_state, loss = step(params, opt_state, next(data_iter))
+        if (i + 1) % args.log_every == 0:
+            dt = (time.time() - t0) / args.log_every
+            logger.info("iter %d loss %.4f %.3fs/it", i + 1, float(loss),
+                        dt)
+            t0 = time.time()
+    if args.ckpt_dir and jax.process_index() == 0:
+        from oktopk_tpu.train.checkpoint import save_checkpoint
+        save_checkpoint(args.ckpt_dir, {"params": params,
+                                        "model_state": {}},
+                        args.num_minibatches)
     return 0
 
 

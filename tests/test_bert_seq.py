@@ -1,0 +1,77 @@
+"""Sequence-parallel BERT (ring attention over a seq mesh axis) vs the
+single-module oracle — long-context support the reference lacks
+(SURVEY.md §5.7)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from oktopk_tpu.models.bert import BertConfig, BertForPreTraining
+from oktopk_tpu.parallel.bert_seq import build_seq_loss, make_seq_mesh
+from oktopk_tpu.train import losses
+
+B, T = 4, 32
+
+
+@pytest.fixture(scope="module")
+def cfg():
+    return BertConfig.tiny()
+
+
+@pytest.fixture(scope="module")
+def params(cfg):
+    ex = jnp.zeros((2, T), jnp.int32)
+    rng = jax.random.PRNGKey(0)
+    return BertForPreTraining(cfg).init(
+        {"params": rng, "dropout": rng}, ex, ex, jnp.ones_like(ex),
+        train=False)["params"]
+
+
+def make_batch(rng, vocab):
+    ids = rng.randint(0, vocab, size=(B, T)).astype(np.int32)
+    mlm = np.full((B, T), -1, np.int32)
+    pos = rng.rand(B, T) < 0.2
+    mlm[pos] = ids[pos]
+    amask = np.ones((B, T), np.int32)
+    amask[:, -5:] = 0                      # padding tail crosses shards
+    return {"input_ids": jnp.asarray(ids),
+            "token_type_ids": jnp.zeros((B, T), jnp.int32),
+            "attention_mask": jnp.asarray(amask),
+            "mlm_labels": jnp.asarray(mlm),
+            "nsp_labels": jnp.asarray(
+                rng.randint(0, 2, size=(B,)).astype(np.int32))}
+
+
+def oracle_loss(cfg, params, batch):
+    mlm, nsp = BertForPreTraining(cfg).apply(
+        {"params": params}, batch["input_ids"], batch["token_type_ids"],
+        batch["attention_mask"], train=False)
+    loss, _ = losses.bert_pretrain_loss(mlm, nsp, batch["mlm_labels"],
+                                        batch["nsp_labels"])
+    return loss
+
+
+class TestBertSeqParallel:
+    @pytest.mark.parametrize("shards", [2, 4, 8])
+    def test_loss_matches_single_module(self, cfg, params, shards):
+        batch = make_batch(np.random.RandomState(1), cfg.vocab_size)
+        want = float(oracle_loss(cfg, params, batch))
+        mesh = make_seq_mesh(shards)
+        loss_fn = build_seq_loss(cfg, mesh)
+        got = float(loss_fn(params, batch))
+        np.testing.assert_allclose(got, want, rtol=2e-5)
+
+    def test_gradients_match_single_module(self, cfg, params):
+        batch = make_batch(np.random.RandomState(2), cfg.vocab_size)
+        g_ref = jax.grad(
+            lambda p: oracle_loss(cfg, p, batch))(params)
+        mesh = make_seq_mesh(4)
+        loss_fn = build_seq_loss(cfg, mesh)
+        g_seq = jax.grad(lambda p: loss_fn(p, batch))(params)
+        for (pa, a), (_, b) in zip(
+                jax.tree_util.tree_leaves_with_path(g_ref),
+                jax.tree_util.tree_leaves_with_path(g_seq)):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), atol=5e-5,
+                err_msg=jax.tree_util.keystr(pa))
