@@ -124,16 +124,9 @@ def pack_by_region(x: jnp.ndarray, mask: jnp.ndarray,
     """
     n = x.size
     if use_pallas and thresh is not None and x.dtype == jnp.float32:
-        from oktopk_tpu.ops.compaction import select_by_threshold_pallas
-        vs, ids_, cs = [], [], []
-        for r in range(num_regions):
-            v, i, c = select_by_threshold_pallas(
-                x, thresh, cap, lo=boundaries[r], hi=boundaries[r + 1])
-            vs.append(v)
-            ids_.append(i)
-            cs.append(c)
-        return (jnp.stack(vs), jnp.stack(ids_),
-                jnp.stack(cs).astype(jnp.int32))
+        from oktopk_tpu.ops.compaction import pack_by_region_pallas
+        return pack_by_region_pallas(x, thresh, boundaries, num_regions,
+                                     cap)
     ids = jnp.arange(n, dtype=jnp.int32)
     # region id per element; boundaries[1:-1] are the interior cut points.
     rid = jnp.searchsorted(boundaries[1:-1], ids, side="right").astype(jnp.int32)

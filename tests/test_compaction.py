@@ -91,6 +91,50 @@ class TestCompactionParity:
         np.testing.assert_array_equal(gv[:gc], x[want])
 
 
+class TestPackRegionsParity:
+    """Single-sweep multi-region kernel vs the portable pack_by_region."""
+
+    @pytest.mark.parametrize("bounds", [
+        [0, 1024, 2048, 3072],          # block-aligned
+        [0, 700, 1930, 3072],           # unaligned
+        [0, 64, 80, 3072],              # tiny regions inside one block
+        [0, 0, 1500, 3072],             # empty first region
+    ])
+    def test_matches_portable(self, bounds):
+        from oktopk_tpu.ops.compaction import pack_by_region_pallas
+        from oktopk_tpu.ops.select import pack_by_region
+
+        n = 3 * BLK
+        rng = np.random.RandomState(5)
+        x = rng.randn(n).astype(np.float32)
+        t, cap = 1.0, 256
+        R = len(bounds) - 1
+        b = jnp.asarray(bounds, jnp.int32)
+        gv, gi, gc = [np.asarray(a) for a in pack_by_region_pallas(
+            jnp.asarray(x), t, b, R, cap, interpret=True)]
+        wv, wi, wc = [np.asarray(a) for a in pack_by_region(
+            jnp.asarray(x), jnp.abs(jnp.asarray(x)) >= t, b, R, cap)]
+        np.testing.assert_array_equal(gc, wc)
+        np.testing.assert_array_equal(gi, wi)
+        np.testing.assert_array_equal(gv, wv)
+
+    def test_cap_overflow_per_region(self):
+        from oktopk_tpu.ops.compaction import pack_by_region_pallas
+        from oktopk_tpu.ops.select import pack_by_region
+
+        n = 2 * BLK
+        rng = np.random.RandomState(6)
+        x = rng.randn(n).astype(np.float32)
+        b = jnp.asarray([0, n // 2, n], jnp.int32)
+        gv, gi, gc = [np.asarray(a) for a in pack_by_region_pallas(
+            jnp.asarray(x), 0.3, b, 2, 64, interpret=True)]  # far over cap
+        wv, wi, wc = [np.asarray(a) for a in pack_by_region(
+            jnp.asarray(x), jnp.abs(jnp.asarray(x)) >= 0.3, b, 2, 64)]
+        np.testing.assert_array_equal(gc, wc)
+        np.testing.assert_array_equal(gi, wi)
+        np.testing.assert_array_equal(gv, wv)
+
+
 class TestOkTopkPallasParity:
     def test_full_algorithm_matches_portable(self, mesh8, monkeypatch):
         """The whole oktopk step with the Pallas selection path (interpret
