@@ -110,10 +110,16 @@ def step_time_probe(iters=10):
     # oktopk_b4 = 4 reverse-layer-order buckets (comm/backward overlap,
     # reference VGG/allreducer.py:27) — the delta vs single-bucket oktopk
     # is the measured overlap benefit
-    for comp, buckets in (("dense", 1), ("oktopk", 1), ("oktopk_b4", 4)):
+    # dense_bf16 = mixed-precision compute (2x MXU peak) — the TPU-first
+    # headroom above the reference's f32 VGG workload
+    for comp, buckets, dt in (("dense", 1, "float32"),
+                              ("oktopk", 1, "float32"),
+                              ("oktopk_b4", 4, "float32"),
+                              ("dense_bf16", 1, "bfloat16")):
         cfg = TrainConfig(dnn="vgg16", dataset="cifar10", batch_size=16,
                           lr=0.1, compressor=comp.split("_")[0],
-                          density=0.02, num_workers=1, num_buckets=buckets)
+                          density=0.02, num_workers=1, num_buckets=buckets,
+                          compute_dtype=dt)
         trainer = Trainer(cfg, mesh=mesh, warmup=False)
         _ = _time_steps(trainer, batch, 2)        # compile + warm
         times = _time_steps(trainer, batch, iters)
@@ -190,6 +196,7 @@ def main():
     }
     for key in ("device", "oktopk_ms", "oktopk_ms_std", "dense_ms",
                 "dense_ms_std", "oktopk_b4_ms", "oktopk_b4_ms_std",
+                "dense_bf16_ms", "dense_bf16_ms_std",
                 "flops_per_step", "peak_flops_assumed",
                 "mfu_dense", "mfu_oktopk"):
         if key in steps:

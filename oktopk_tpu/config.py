@@ -197,6 +197,12 @@ class TrainConfig:
     # BERT-style warmup-linear schedule knobs (transformers/optimization.py).
     warmup_proportion: float = 0.01
     total_steps: int = 0
+    # Mixed precision: computation dtype for the model's matmuls/convs
+    # ("bfloat16" doubles MXU throughput; master params, grads, the sparse
+    # collective and the optimizer all stay float32). This replaces the
+    # reference's NVIDIA-apex amp path (BERT/bert/main_bert.py:15,1009-1023,
+    # SURVEY.md §2.4).
+    compute_dtype: str = "float32"
     # Comm/backward overlap: number of reverse-layer-order gradient buckets,
     # each with its own sparse collective + SparseState (reference <=640 MiB
     # bucketing, VGG/allreducer.py:27,272-330). 1 = whole-model flat.

@@ -13,16 +13,17 @@ import optax
 
 
 def softmax_cross_entropy(logits, labels):
-    """Mean CE over integer labels [B] (CNN classification)."""
+    """Mean CE over integer labels [B] (CNN classification). Logits cast
+    to f32 so bf16 compute never runs the softmax reduction in bf16."""
     return optax.softmax_cross_entropy_with_integer_labels(
-        logits, labels).mean()
+        logits.astype(jnp.float32), labels).mean()
 
 
 def lm_cross_entropy(logits, targets):
     """Mean CE over [B, T] targets (PTB language modelling; perplexity =
     exp(loss))."""
     return optax.softmax_cross_entropy_with_integer_labels(
-        logits, targets).mean()
+        logits.astype(jnp.float32), targets).mean()
 
 
 def ctc_loss(logits, logit_lengths, labels, label_lengths, blank_id: int = 0):

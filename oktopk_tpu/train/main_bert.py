@@ -31,6 +31,8 @@ def parse_args(argv=None):
     p.add_argument("--num-minibatches", type=int, default=1024)
     p.add_argument("--gradient-accumulation-steps", type=int, default=1)
     p.add_argument("--compressor", default="oktopk")
+    p.add_argument("--compute-dtype", default="float32",
+                   choices=["float32", "bfloat16"])
     p.add_argument("--density", type=float, default=0.01)
     p.add_argument("--pipeline-stages", type=int, default=1,
                    help="pipeline depth: split the encoder over a "
@@ -87,6 +89,7 @@ def main(argv=None):
         lr=args.lr, compressor=args.compressor, density=args.density,
         nsteps_update=args.gradient_accumulation_steps, seed=args.seed,
         warmup_proportion=args.warmup_proportion,
+        compute_dtype=args.compute_dtype,
         total_steps=args.num_minibatches, num_workers=num_workers)
     logger = get_logger("oktopk_tpu.bert")
     logger.info("BERT pretrain: %s on %d devices, compressor=%s density=%g",

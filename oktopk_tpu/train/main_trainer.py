@@ -34,6 +34,10 @@ def parse_args(argv=None):
     p.add_argument("--max-iters", type=int, default=0,
                    help="if set, run exactly this many iterations")
     p.add_argument("--nsteps-update", type=int, default=1)
+    p.add_argument("--compute-dtype", default="float32",
+                   choices=["float32", "bfloat16"],
+                   help="model computation dtype (bf16 = 2x MXU; params/"
+                        "grads/collective stay f32 - the apex-amp role)")
     p.add_argument("--num-buckets", type=int, default=1,
                    help="reverse-layer-order gradient buckets, one sparse "
                         "collective each (reference <=640MiB bucketing, "
@@ -101,6 +105,7 @@ def main(argv=None):
         nesterov=args.nesterov, max_epochs=args.max_epochs,
         nsteps_update=args.nsteps_update, compressor=args.compressor,
         num_buckets=args.num_buckets,
+        compute_dtype=args.compute_dtype,
         density=args.density, sigma_scale=args.sigma_scale,
         grad_clip=args.grad_clip, seed=args.seed,
         num_workers=len(jax.devices()))

@@ -62,6 +62,11 @@ class Trainer:
         self.cfg = cfg
 
         mk = dict(model_kwargs or {})
+        if cfg.compute_dtype != "float32":
+            # mixed precision: flax `dtype` sets computation dtype only;
+            # params stay float32 (flax param_dtype default) — the apex-amp
+            # replacement (SURVEY.md §2.4)
+            mk.setdefault("dtype", jnp.dtype(cfg.compute_dtype))
         self.model, example_fn = create_model(cfg.dnn, **mk)
         self.example_fn = example_fn
 

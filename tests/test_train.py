@@ -157,3 +157,31 @@ class TestBucketedAllreduce:
         for a, b in zip(jax.tree.leaves(tr.state),
                         jax.tree.leaves(restored)):
             np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+class TestMixedPrecision:
+    """compute_dtype=bfloat16: bf16 matmuls, f32 master params/grads/
+    collective (the reference's apex-amp role, SURVEY.md 2.4)."""
+
+    def test_bf16_compute_trains(self, mesh4):
+        cfg = TrainConfig(dnn="mnistnet", dataset="mnist", batch_size=8,
+                          lr=0.05, compressor="dense", density=0.05,
+                          compute_dtype="bfloat16")
+        tr = Trainer(cfg, mesh=mesh4, warmup=False)
+        # master params stay f32
+        for leaf in jax.tree.leaves(tr.state.params):
+            assert leaf.dtype == jnp.float32
+        it = synthetic_iterator("mnistnet", 8, seed=1)
+        batch = next(it)
+        losses = [float(tr.train_step(batch)["loss"]) for _ in range(6)]
+        assert np.isfinite(losses).all()
+        assert losses[-1] < losses[0]
+
+    def test_bf16_bert_finite(self, mesh4):
+        cfg = TrainConfig(dnn="bert_tiny", dataset="wikipedia",
+                          batch_size=2, lr=1e-3, compressor="topkA",
+                          density=0.05, compute_dtype="bfloat16")
+        tr = Trainer(cfg, mesh=mesh4, warmup=False)
+        it = synthetic_iterator("bert_tiny", 8, seed=2)
+        m = tr.train_step(next(it))
+        assert np.isfinite(float(m["loss"]))
