@@ -211,9 +211,11 @@ def load_ptb(path: str, split: str = "train", num_steps: int = 35):
 
 def make_dataset(dataset: str, dnn: str, batch_size: int,
                  path: Optional[str] = None, split: str = "train",
-                 seed: int = 0) -> Tuple[Iterator, Dict]:
+                 seed: int = 0,
+                 seq_len: Optional[int] = None) -> Tuple[Iterator, Dict]:
     """Build a batch iterator for (dataset, dnn). Falls back to synthetic
-    data when files are absent."""
+    data when files are absent. ``seq_len`` overrides the per-model default
+    token length (BERT long-context runs)."""
     path = path or os.environ.get("OKTOPK_DATA_DIR", "./data")
     try:
         if dataset == "wikipedia":
@@ -238,7 +240,7 @@ def make_dataset(dataset: str, dnn: str, batch_size: int,
                 tok = FullTokenizer(
                     vocab_file if os.path.exists(vocab_file) else None,
                     fallback_size=vocab_size)
-            seq = 32 if dnn == "bert_tiny" else 128
+            seq = seq_len or (32 if dnn == "bert_tiny" else 128)
             return (pretrain_iterator(corpus, tok, batch_size, seq,
                                       seed, vocab_size),
                     {"synthetic": False, "num_examples": 50000})
@@ -278,5 +280,5 @@ def make_dataset(dataset: str, dnn: str, batch_size: int,
                 {"synthetic": False,
                  "num_examples": len(arrays["label"])})
     except (FileNotFoundError, OSError):
-        return (synthetic_iterator(dnn, batch_size, seed),
+        return (synthetic_iterator(dnn, batch_size, seed, seq_len=seq_len),
                 {"synthetic": True, "num_examples": 50000})

@@ -126,6 +126,9 @@ def bert_seq_loss(params, batch, cfg: BertConfig, axis_name: str = "seq"):
 def make_seq_mesh(num_shards: int, devices=None) -> Mesh:
     import numpy as np
     devices = list(devices if devices is not None else jax.devices())
+    if len(devices) < num_shards:
+        raise ValueError(f"seq parallelism needs {num_shards} devices, "
+                         f"have {len(devices)}")
     return Mesh(np.asarray(devices[:num_shards]), ("seq",))
 
 

@@ -25,7 +25,8 @@ def parse_args(argv=None):
                    choices=["bert_base", "bert_large", "bert_tiny"])
     p.add_argument("--batch-size", type=int, default=8,
                    help="per-worker microbatch (reference bs 8)")
-    p.add_argument("--max-seq-length", type=int, default=128)
+    p.add_argument("--max-seq-length", type=int, default=None,
+                   help="default: 128 (32 for bert_tiny)")
     p.add_argument("--lr", type=float, default=2e-4)
     p.add_argument("--warmup-proportion", type=float, default=0.01)
     p.add_argument("--num-minibatches", type=int, default=1024)
@@ -60,6 +61,8 @@ def parse_args(argv=None):
 
 def main(argv=None):
     args = parse_args(argv)
+    if args.max_seq_length is None:
+        args.max_seq_length = 32 if args.model == "bert_tiny" else 128
     if args.fake_devices:
         os.environ["XLA_FLAGS"] = (
             os.environ.get("XLA_FLAGS", "")
@@ -122,7 +125,8 @@ def main(argv=None):
     global_bs = (args.batch_size * num_workers
                  * args.gradient_accumulation_steps)
     data_iter, meta = make_dataset("wikipedia", args.model, global_bs,
-                                   path=args.data_dir, seed=args.seed)
+                                   path=args.data_dir, seed=args.seed,
+                                   seq_len=args.max_seq_length)
     if meta.get("synthetic"):
         logger.warning("Wikipedia shards not found: synthetic MLM/NSP data")
 
@@ -184,7 +188,8 @@ def run_pipeline(args):
 
     global_bs = args.batch_size * dp * args.num_microbatches
     data_iter, meta = make_dataset("wikipedia", args.model, global_bs,
-                                   path=args.data_dir, seed=args.seed)
+                                   path=args.data_dir, seed=args.seed,
+                                   seq_len=args.max_seq_length)
     if meta.get("synthetic"):
         logger.warning("Wikipedia shards not found: synthetic MLM/NSP data")
 
@@ -226,10 +231,24 @@ def run_seq_parallel(args):
     import jax.numpy as jnp
 
     logger = get_logger("oktopk_tpu.bert")
-    cfg = {"bert_base": BertConfig.base, "bert_large": BertConfig.large,
-           "bert_tiny": BertConfig.tiny}[args.model]()
     if args.max_seq_length % args.seq_shards:
         raise SystemExit("--max-seq-length must divide by --seq-shards")
+    if args.compressor != "dense":
+        raise SystemExit(
+            "--seq-shards trains with dense gradients (sequence shards "
+            "see the full replicated parameter set; composing the sparse "
+            "collectives needs a data axis) — pass --compressor dense")
+    if args.gradient_accumulation_steps != 1:
+        raise SystemExit("--gradient-accumulation-steps is not wired into "
+                         "the seq-parallel path yet")
+    import dataclasses
+    dtype = jnp.dtype(args.compute_dtype)
+    cfg = {"bert_base": BertConfig.base, "bert_large": BertConfig.large,
+           "bert_tiny": BertConfig.tiny}[args.model](dtype=dtype)
+    if cfg.max_position < args.max_seq_length:
+        # long-context runs need position rows for every global position —
+        # the embedding gather clamps silently under jit otherwise
+        cfg = dataclasses.replace(cfg, max_position=args.max_seq_length)
     mesh = make_seq_mesh(args.seq_shards)
     logger.info("seq-parallel BERT: %s, T=%d over %d shards "
                 "(T/P=%d per chip)", args.model, args.max_seq_length,
@@ -246,7 +265,8 @@ def run_seq_parallel(args):
     step = build_seq_train_step(cfg, mesh, opt)
 
     data_iter, meta = make_dataset("wikipedia", args.model, args.batch_size,
-                                   path=args.data_dir, seed=args.seed)
+                                   path=args.data_dir, seed=args.seed,
+                                   seq_len=args.max_seq_length)
     if meta.get("synthetic"):
         logger.warning("Wikipedia shards not found: synthetic MLM/NSP data")
 
