@@ -1,0 +1,202 @@
+"""Tensor-parallel BERT: Megatron-style head/FFN sharding over ``model``.
+
+The reference has no tensor parallelism (SURVEY.md §2.3 — absent); this is
+a TPU-side extension completing the mesh-axes story (data x pipe x seq x
+model). The classic two-psum-per-layer decomposition:
+
+- attention: the head dimension is sharded — each rank runs H/P full
+  attention heads (column-parallel QKV, row-parallel output projection,
+  ONE psum after the out-projection);
+- MLP: column-parallel intermediate Dense, row-parallel output Dense,
+  ONE psum after it (biases of row-parallel layers are added post-psum so
+  they are counted once);
+- LayerNorms, embeddings, pooler and the MLM/NSP heads stay replicated.
+
+As with the other parallel forms (bert_staged, bert_seq), the math consumes
+a re-layout of the *unchanged* ``BertForPreTraining`` tree —
+``split_tp``/``merge_tp`` interconvert — so loss and gradients are
+equivalence-testable against the single-module oracle and checkpoints
+interchange.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from oktopk_tpu.models.bert import BertConfig
+from oktopk_tpu.parallel.bert_seq import _dense, _layer_norm
+
+
+def split_tp(params, num_shards: int):
+    """Single-module params -> (tp_stack, shared).
+
+    ``tp_stack`` leaves carry a leading [P] shard axis: per layer, the
+    attention query/key/value kernels+biases split on the head dim, the
+    out-projection kernel splits on its head input dim, the MLP
+    intermediate kernel+bias split on the feature dim and the MLP output
+    kernel on its feature input dim. ``shared`` holds everything else
+    (including row-parallel output biases, applied once post-psum)."""
+    def shard(x, axis):
+        parts = jnp.split(x, num_shards, axis=axis)
+        return jnp.stack(parts)
+
+    enc = params["bert"]["encoder"]
+    tp_layers, sh_layers = {}, {}
+    for name, lp in enc.items():
+        a = lp["attention"]
+        tp_layers[name] = {
+            "attention": {
+                **{k: {"kernel": shard(a[k]["kernel"], 1),
+                       "bias": shard(a[k]["bias"], 0)}
+                   for k in ("query", "key", "value")},
+                "out": {"kernel": shard(a["out"]["kernel"], 0)},
+            },
+            "intermediate": {"kernel": shard(lp["intermediate"]["kernel"], 1),
+                             "bias": shard(lp["intermediate"]["bias"], 0)},
+            "output": {"kernel": shard(lp["output"]["kernel"], 0)},
+        }
+        sh_layers[name] = {
+            "attention_out_bias": a["out"]["bias"],
+            "output_bias": lp["output"]["bias"],
+            "attention_ln": lp["attention_ln"],
+            "output_ln": lp["output_ln"],
+        }
+    shared = {
+        "embeddings": params["bert"]["embeddings"],
+        "pooler": params["bert"]["pooler"],
+        "mlm_dense": params["mlm_dense"],
+        "mlm_ln": params["mlm_ln"],
+        "mlm_bias": params["mlm_bias"],
+        "nsp": params["nsp"],
+        "layers": sh_layers,
+    }
+    return tp_layers, shared
+
+
+def merge_tp(tp_layers, shared):
+    """Inverse of :func:`split_tp`."""
+    def unshard(x, axis):
+        return jnp.concatenate([x[i] for i in range(x.shape[0])], axis=axis)
+
+    enc = {}
+    for name, lp in tp_layers.items():
+        a = lp["attention"]
+        sh = shared["layers"][name]
+        enc[name] = {
+            "attention": {
+                **{k: {"kernel": unshard(a[k]["kernel"], 1),
+                       "bias": unshard(a[k]["bias"], 0)}
+                   for k in ("query", "key", "value")},
+                "out": {"kernel": unshard(a["out"]["kernel"], 0),
+                        "bias": sh["attention_out_bias"]},
+            },
+            "attention_ln": sh["attention_ln"],
+            "intermediate": {
+                "kernel": unshard(lp["intermediate"]["kernel"], 1),
+                "bias": unshard(lp["intermediate"]["bias"], 0)},
+            "output": {"kernel": unshard(lp["output"]["kernel"], 0),
+                       "bias": sh["output_bias"]},
+            "output_ln": sh["output_ln"],
+        }
+    return {
+        "bert": {"embeddings": shared["embeddings"],
+                 "encoder": enc,
+                 "pooler": shared["pooler"]},
+        "mlm_dense": shared["mlm_dense"],
+        "mlm_ln": shared["mlm_ln"],
+        "mlm_bias": shared["mlm_bias"],
+        "nsp": shared["nsp"],
+    }
+
+
+def _tp_attention(tp, out_bias, x, attn_mask, axis_name):
+    """H/P-head attention + row-parallel out projection (one psum)."""
+    def proj(pp):
+        return jnp.einsum("bte,ehd->bthd", x, pp["kernel"]) + pp["bias"]
+
+    q = proj(tp["query"])                       # [B, T, Hl, D]
+    k = proj(tp["key"])
+    v = proj(tp["value"])
+    d = q.shape[-1]
+    s = jnp.einsum("bthd,bshd->bhts", q * (d ** -0.5), k)
+    s = jnp.where(attn_mask, s, jnp.asarray(-1e30, s.dtype))
+    p = jax.nn.softmax(s, axis=-1)
+    o = jnp.einsum("bhts,bshd->bthd", p, v)
+    partial = jnp.einsum("bthd,hde->bte", o, tp["out"]["kernel"])
+    return lax.psum(partial, axis_name) + out_bias
+
+
+def _tp_layer(tp, sh, x, attn_mask, cfg: BertConfig, axis_name):
+    y = _tp_attention(tp["attention"], sh["attention_out_bias"], x,
+                      attn_mask, axis_name)
+    x = _layer_norm(sh["attention_ln"], x + y, cfg.layer_norm_eps)
+    h = jnp.einsum("bte,ef->btf", x, tp["intermediate"]["kernel"]) \
+        + tp["intermediate"]["bias"]
+    h = jax.nn.gelu(h, approximate=False)
+    partial = jnp.einsum("btf,fe->bte", h, tp["output"]["kernel"])
+    h = lax.psum(partial, axis_name) + sh["output_bias"]
+    return _layer_norm(sh["output_ln"], x + h, cfg.layer_norm_eps)
+
+
+def bert_tp_loss(tp_layers, shared, batch, cfg: BertConfig,
+                 axis_name: str = "model"):
+    """Replicated-batch MLM+NSP loss with tensor-parallel layers (inside
+    shard_map; ``tp_layers`` leaves are this rank's [1, ...] shard rows)."""
+    import optax
+
+    tp_local = jax.tree.map(lambda x: x[0], tp_layers)
+    ids = batch["input_ids"]
+    B, T = ids.shape
+    emb = shared["embeddings"]
+    positions = jnp.arange(T)[None, :]
+    x = (emb["word_embeddings"]["embedding"][ids]
+         + emb["position_embeddings"]["embedding"][positions]
+         + emb["token_type_embeddings"]["embedding"][batch["token_type_ids"]])
+    x = _layer_norm(emb["LayerNorm_0"], x, cfg.layer_norm_eps)
+
+    mask = batch["attention_mask"][:, None, None, :].astype(bool)
+    for i in range(cfg.num_layers):
+        x = _tp_layer(tp_local[f"layer_{i}"],
+                      shared["layers"][f"layer_{i}"], x, mask, cfg,
+                      axis_name)
+
+    pooled = jnp.tanh(_dense(shared["pooler"], x[:, 0]))
+    h = _dense(shared["mlm_dense"], x)
+    h = jax.nn.gelu(h, approximate=False)
+    h = _layer_norm(shared["mlm_ln"], h, cfg.layer_norm_eps)
+    table = emb["word_embeddings"]["embedding"]
+    mlm = (jnp.einsum("bth,vh->btv", h, table.astype(cfg.dtype))
+           + shared["mlm_bias"]).astype(jnp.float32)
+    nsp = _dense(shared["nsp"], pooled).astype(jnp.float32)
+
+    lmask = (batch["mlm_labels"] >= 0).astype(jnp.float32)
+    safe = jnp.maximum(batch["mlm_labels"], 0)
+    per_tok = optax.softmax_cross_entropy_with_integer_labels(mlm, safe)
+    mlm_loss = jnp.sum(per_tok * lmask) / jnp.maximum(jnp.sum(lmask), 1.0)
+    nsp_loss = optax.softmax_cross_entropy_with_integer_labels(
+        nsp, batch["nsp_labels"]).mean()
+    return mlm_loss + nsp_loss
+
+
+def make_tp_mesh(num_shards: int, devices=None) -> Mesh:
+    import numpy as np
+    devices = list(devices if devices is not None else jax.devices())
+    if len(devices) < num_shards:
+        raise ValueError(f"tensor parallelism needs {num_shards} devices, "
+                         f"have {len(devices)}")
+    return Mesh(np.asarray(devices[:num_shards]), ("model",))
+
+
+def build_tp_loss(cfg: BertConfig, mesh: Mesh, axis_name: str = "model"):
+    """jit ``(tp_stack, shared, batch) -> loss`` (batch replicated,
+    tp_stack sharded over ``model``)."""
+    def shard_fn(tp_layers, shared, batch):
+        return bert_tp_loss(tp_layers, shared, batch, cfg, axis_name)
+
+    mapped = jax.shard_map(shard_fn, mesh=mesh,
+                           in_specs=(P(axis_name), P(), P()),
+                           out_specs=P())
+    return jax.jit(mapped)

@@ -1,0 +1,87 @@
+"""Tensor-parallel BERT (Megatron-style head/FFN sharding over a model
+mesh axis) vs the single-module oracle. TP is absent from the reference
+(SURVEY.md §2.3) — this is the extension completing dp/pp/sp/tp."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from oktopk_tpu.models.bert import BertConfig, BertForPreTraining
+from oktopk_tpu.parallel.bert_tp import (build_tp_loss, make_tp_mesh,
+                                         merge_tp, split_tp)
+from oktopk_tpu.train import losses
+
+B, T = 4, 16
+
+
+@pytest.fixture(scope="module")
+def cfg():
+    return BertConfig.tiny()
+
+
+@pytest.fixture(scope="module")
+def params(cfg):
+    ex = jnp.zeros((2, T), jnp.int32)
+    rng = jax.random.PRNGKey(0)
+    return BertForPreTraining(cfg).init(
+        {"params": rng, "dropout": rng}, ex, ex, jnp.ones_like(ex),
+        train=False)["params"]
+
+
+def make_batch(rng, vocab):
+    ids = rng.randint(0, vocab, size=(B, T)).astype(np.int32)
+    mlm = np.full((B, T), -1, np.int32)
+    pos = rng.rand(B, T) < 0.2
+    mlm[pos] = ids[pos]
+    amask = np.ones((B, T), np.int32)
+    amask[:, -3:] = 0
+    return {"input_ids": jnp.asarray(ids),
+            "token_type_ids": jnp.zeros((B, T), jnp.int32),
+            "attention_mask": jnp.asarray(amask),
+            "mlm_labels": jnp.asarray(mlm),
+            "nsp_labels": jnp.asarray(
+                rng.randint(0, 2, size=(B,)).astype(np.int32))}
+
+
+def oracle_loss(cfg, params, batch):
+    mlm, nsp = BertForPreTraining(cfg).apply(
+        {"params": params}, batch["input_ids"], batch["token_type_ids"],
+        batch["attention_mask"], train=False)
+    loss, _ = losses.bert_pretrain_loss(mlm, nsp, batch["mlm_labels"],
+                                        batch["nsp_labels"])
+    return loss
+
+
+class TestBertTensorParallel:
+    def test_split_merge_roundtrip(self, cfg, params):
+        tp, shared = split_tp(params, 2)
+        merged = merge_tp(tp, shared)
+        for (pa, a), (pb, b) in zip(
+                jax.tree_util.tree_leaves_with_path(params),
+                jax.tree_util.tree_leaves_with_path(merged)):
+            assert pa == pb
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_loss_matches_single_module(self, cfg, params):
+        batch = make_batch(np.random.RandomState(1), cfg.vocab_size)
+        want = float(oracle_loss(cfg, params, batch))
+        tp, shared = split_tp(params, 2)   # tiny has 2 heads -> TP=2 max
+        loss_fn = build_tp_loss(cfg, make_tp_mesh(2))
+        got = float(loss_fn(tp, shared, batch))
+        np.testing.assert_allclose(got, want, rtol=2e-5)
+
+    def test_gradients_match_single_module(self, cfg, params):
+        batch = make_batch(np.random.RandomState(2), cfg.vocab_size)
+        g_ref = jax.grad(lambda p: oracle_loss(cfg, p, batch))(params)
+        tp, shared = split_tp(params, 2)
+        loss_fn = build_tp_loss(cfg, make_tp_mesh(2))
+        g_tp, g_sh = jax.grad(
+            lambda t, s: loss_fn(t, s, batch), argnums=(0, 1))(tp, shared)
+        g_merged = merge_tp(g_tp, g_sh)
+        for (pa, a), (_, b) in zip(
+                jax.tree_util.tree_leaves_with_path(g_ref),
+                jax.tree_util.tree_leaves_with_path(g_merged)):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), atol=5e-5,
+                err_msg=jax.tree_util.keystr(pa))
