@@ -107,28 +107,48 @@ def bert_seq_forward(params, input_ids, token_type_ids, attention_mask,
     return mlm.astype(jnp.float32), nsp.astype(jnp.float32)
 
 
-def bert_seq_loss(params, batch, cfg: BertConfig, axis_name: str = "seq"):
-    """Global MLM+NSP loss from local shards (inside shard_map)."""
+def bert_seq_loss(params, batch, cfg: BertConfig, axis_name: str = "seq",
+                  data_axis: Optional[str] = None):
+    """Global MLM+NSP loss from local shards (inside shard_map).
+
+    With ``data_axis`` set the mesh is 2-D (batch over ``data``, tokens
+    over ``seq``) and the loss reductions span both axes (weighted
+    psum-of-sums — a mean of per-shard means would be wrong whenever
+    masked-token counts differ across shards)."""
     import optax
     mlm, nsp = bert_seq_forward(params, batch["input_ids"],
                                 batch["token_type_ids"],
                                 batch["attention_mask"], cfg, axis_name)
+    axes = (axis_name,) if data_axis is None else (axis_name, data_axis)
     mask = (batch["mlm_labels"] >= 0).astype(jnp.float32)
     safe = jnp.maximum(batch["mlm_labels"], 0)
     per_tok = optax.softmax_cross_entropy_with_integer_labels(mlm, safe)
-    num = lax.psum(jnp.sum(per_tok * mask), axis_name)
-    den = lax.psum(jnp.sum(mask), axis_name)
-    nsp_loss = optax.softmax_cross_entropy_with_integer_labels(
-        nsp, batch["nsp_labels"]).mean()
+    num = lax.psum(jnp.sum(per_tok * mask), axes)
+    den = lax.psum(jnp.sum(mask), axes)
+    nsp_ce = optax.softmax_cross_entropy_with_integer_labels(
+        nsp, batch["nsp_labels"])
+    if data_axis is None:
+        nsp_loss = nsp_ce.mean()
+    else:
+        nsp_loss = (lax.psum(jnp.sum(nsp_ce), data_axis)
+                    / lax.psum(jnp.asarray(nsp_ce.shape[0], jnp.float32),
+                               data_axis))
     return num / jnp.maximum(den, 1.0) + nsp_loss
 
 
-def make_seq_mesh(num_shards: int, devices=None) -> Mesh:
+def make_seq_mesh(num_shards: int, devices=None,
+                  data_size: int = 1) -> Mesh:
+    """1-D ("seq",) mesh, or 2-D ("data", "seq") when ``data_size > 1``."""
     import numpy as np
     devices = list(devices if devices is not None else jax.devices())
-    if len(devices) < num_shards:
-        raise ValueError(f"seq parallelism needs {num_shards} devices, "
+    need = num_shards * data_size
+    if len(devices) < need:
+        raise ValueError(f"seq parallelism needs {need} devices, "
                          f"have {len(devices)}")
+    if data_size > 1:
+        return Mesh(np.asarray(devices[:need]).reshape(data_size,
+                                                       num_shards),
+                    ("data", "seq"))
     return Mesh(np.asarray(devices[:num_shards]), ("seq",))
 
 
@@ -159,15 +179,18 @@ def build_seq_train_step(cfg: BertConfig, mesh: Mesh, optimizer,
 def build_seq_loss(cfg: BertConfig, mesh: Mesh,
                    axis_name: str = "seq"):
     """jit ``(params, batch) -> loss`` with batch token dims sharded over
-    ``seq``. ``nsp_labels`` is replicated; everything else [B, T] splits on
-    the token axis."""
-    tok_spec = P(None, axis_name)
+    ``seq`` (and the batch dim over ``data`` if the mesh has that axis —
+    the composed dp x sp form). ``nsp_labels`` follows the batch dim;
+    everything else [B, T] splits on the token axis."""
+    data_axis = "data" if "data" in mesh.axis_names else None
+    tok_spec = P(data_axis, axis_name)
     batch_specs = {"input_ids": tok_spec, "token_type_ids": tok_spec,
                    "attention_mask": tok_spec, "mlm_labels": tok_spec,
-                   "nsp_labels": P()}
+                   "nsp_labels": P(data_axis)}
 
     def shard_fn(params, batch):
-        return bert_seq_loss(params, batch, cfg, axis_name)
+        return bert_seq_loss(params, batch, cfg, axis_name,
+                             data_axis=data_axis)
 
     mapped = jax.shard_map(shard_fn, mesh=mesh,
                            in_specs=(P(), batch_specs), out_specs=P())

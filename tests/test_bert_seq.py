@@ -62,6 +62,16 @@ class TestBertSeqParallel:
         got = float(loss_fn(params, batch))
         np.testing.assert_allclose(got, want, rtol=2e-5)
 
+    def test_composed_data_x_seq_mesh(self, cfg, params):
+        """dp x sp composition: batch over 'data', tokens over 'seq' —
+        loss still equals the single-module global loss."""
+        batch = make_batch(np.random.RandomState(3), cfg.vocab_size)
+        want = float(oracle_loss(cfg, params, batch))
+        mesh = make_seq_mesh(4, data_size=2)
+        loss_fn = build_seq_loss(cfg, mesh)
+        got = float(loss_fn(params, batch))
+        np.testing.assert_allclose(got, want, rtol=2e-5)
+
     def test_gradients_match_single_module(self, cfg, params):
         batch = make_batch(np.random.RandomState(2), cfg.vocab_size)
         g_ref = jax.grad(
