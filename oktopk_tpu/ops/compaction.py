@@ -8,13 +8,16 @@ Pallas kernel that does what the hardware is good at:
 
   per 1024-element block (one [8, 128] f32 tile):
     mask -> in-block exclusive prefix sum (7+3 shifted adds on the VPU)
-    -> one-hot [1024, capb] matrix                 (VPU compares)
-    -> ONE [4, 1024] @ [1024, capb] MXU matmul     (the "scatter")
+    -> per sublane-row transposed one-hot [capb, 128] (VPU compares; built
+       by sublane-broadcast + iota, never reshaping across lanes — Mosaic
+       rejects cross-lane shape casts like [8,128]->[1024,1])
+    -> eight [4, 128] x [capb, 128]^T MXU matmuls  (the "scatter")
     -> sliced DMA append to the output at the running base offset.
 
-The matmul compacts four row vectors at once: the value and the global index,
-each split into two 16-bit halves (every half is < 2^16 so it rides the MXU
-exactly regardless of f32 matmul precision; recombined by bit ops after the
+The matmuls compact four row vectors at once: the value and the global index,
+each split into two 16-bit halves (every half is < 2^16, exact in f32; the
+dots run at Precision.HIGHEST because the default matmul path rounds MXU
+inputs to bf16's 8 mantissa bits; recombined by bit ops after the
 kernel). The running base lives in SMEM scratch and the grid is declared
 sequential ("arbitrary" dimension semantics), so each block's DMA lands after
 the previous block's — a block writes its full ``capb`` staging row and the
@@ -65,26 +68,84 @@ def _capb_for(cap: int) -> int:
 
 
 def _shift_right(x, d, axis):
-    """x shifted ``d`` slots toward higher indices along ``axis``, zero-fill."""
-    pad = [(0, 0), (0, 0)]
-    pad[axis] = (d, 0)
+    """x shifted ``d`` slots toward higher indices along ``axis``, zero-fill.
+
+    Concat + static slice only (``jnp.pad`` is not guaranteed a Mosaic
+    lowering)."""
+    zshape = list(x.shape)
+    zshape[axis] = d
     sl = [slice(None), slice(None)]
     sl[axis] = slice(0, x.shape[axis] - d)
-    return jnp.pad(x[tuple(sl)], pad)
+    return jnp.concatenate([jnp.zeros(zshape, x.dtype), x[tuple(sl)]],
+                           axis=axis)
 
 
 def _block_prefix(m):
     """Exclusive prefix sum of an [8, 128] i32 tile in row-major order,
-    via Hillis-Steele shifted adds (no cumsum primitive needed in-kernel)."""
+    via Hillis-Steele shifted adds (no cumsum primitive needed in-kernel).
+
+    Only static positive slices and full reductions — scalar extraction
+    like ``r[-1, 0]`` traces to ``dynamic_slice``, which Mosaic's TC
+    lowering rejects (caught on the real chip; the interpreter accepts it).
+    """
     s = m
     for d in (1, 2, 4, 8, 16, 32, 64):           # within-row inclusive scan
         s = s + _shift_right(s, d, axis=1)
-    row_tot = s[:, -1:]                           # [8, 1]
+    row_tot = s[:, BLK_COLS - 1:BLK_COLS]         # [8, 1]
     r = row_tot
     for d in (1, 2, 4):                           # across-row inclusive scan
         r = r + _shift_right(r, d, axis=0)
     row_excl = r - row_tot                        # exclusive row offsets
-    return s - m + row_excl, r[-1, 0]             # (excl. positions, total)
+    return s - m + row_excl, jnp.sum(m)           # (excl. positions, total)
+
+
+def _quantity_rows(x, gidx, kept):
+    """The four compacted quantities — value hi/lo half and global-index
+    hi/lo half — as separate [8, 128] i32 tiles, zeroed outside ``kept``.
+    16-bit pieces are exactly representable in f32 (|q| < 2^16 < 2^24),
+    but only survive the MXU when the dot runs at Precision.HIGHEST — see
+    ``_compact_tile``."""
+    from jax.experimental.pallas import tpu as pltpu
+
+    vbits = pltpu.bitcast(x, jnp.int32)
+    zero = jnp.zeros_like(vbits)
+    return (jnp.where(kept, vbits >> 16, zero),           # arithmetic shift
+            jnp.where(kept, vbits & 0xFFFF, zero),
+            jnp.where(kept, gidx >> 16, zero),
+            jnp.where(kept, gidx & 0xFFFF, zero))
+
+
+def _compact_tile(qs, sel, capb):
+    """The MXU "scatter": stage[s, j] = s-th quantity of the element whose
+    in-block slot is ``j``.
+
+    Mosaic rejects cross-lane reshapes — the obvious ``[8,128] -> [BLK,1]``
+    one-hot layout is an "unsupported shape cast" on real hardware (the
+    interpreter accepts it, which is why only a chip run catches it). So
+    everything stays in tile layout: per sublane-row, broadcast the row's
+    slot vector along a fresh sublane axis, compare with a sublane iota to
+    get the transposed one-hot [capb, 128], and contract both operands on
+    their lane axis (an NT matmul — dimension numbers ((1,),(1,))). Eight
+    [4,128] x [capb,128]^T matmuls replace the single [4,BLK] x [BLK,capb]
+    one; slots are distinct across rows so the accumulation is collision-
+    free and exact."""
+    jio = jax.lax.broadcasted_iota(jnp.float32, (capb, BLK_COLS), 0)
+    acc = jnp.zeros((4, capb), jnp.float32)
+    for r in range(BLK_ROWS):
+        selr = jax.lax.slice(sel, (r, 0), (r + 1, BLK_COLS))   # [1, 128]
+        onehot_t = (jnp.broadcast_to(selr, (capb, BLK_COLS)) == jio) \
+            .astype(jnp.float32)                               # [capb, 128]
+        rows4 = jnp.concatenate(
+            [jax.lax.slice(q, (r, 0), (r + 1, BLK_COLS)).astype(jnp.float32)
+             for q in qs], axis=0)                             # [4, 128]
+        # HIGHEST precision: the default matmul path feeds the MXU bf16
+        # inputs (8 mantissa bits), silently rounding the 16-bit halves;
+        # HIGHEST decomposes f32 exactly, keeping one-hot x half exact.
+        acc = acc + jax.lax.dot_general(
+            rows4, onehot_t, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.HIGHEST)
+    return acc
 
 
 def _compact_kernel(capb, t_ref, r_ref, x_ref, vh_ref, vl_ref, ih_ref,
@@ -112,28 +173,10 @@ def _compact_kernel(capb, t_ref, r_ref, x_ref, vh_ref, vl_ref, ih_ref,
     pos, _ = _block_prefix(m)
 
     kept = mask & (pos < capb)
-    sel = jnp.where(kept, pos, capb)                      # capb = dropped
+    sel = jnp.where(kept, pos, capb).astype(jnp.float32)  # capb = dropped
     stored = jnp.sum(kept.astype(jnp.int32))
 
-    # one-hot compaction matrix [BLK, capb]
-    sel_flat = sel.reshape(BLK, 1)
-    onehot = (sel_flat == jax.lax.broadcasted_iota(
-        jnp.int32, (BLK, capb), 1)).astype(jnp.float32)
-
-    # rows: value hi/lo halves and global-index hi/lo halves — 16-bit
-    # pieces are exact in any MXU f32 path
-    vbits = pltpu.bitcast(x, jnp.int32)
-    zero = jnp.zeros_like(vbits)
-    rows = jnp.stack([
-        jnp.where(kept, vbits >> 16, zero),               # arithmetic shift
-        jnp.where(kept, vbits & 0xFFFF, zero),
-        jnp.where(kept, gidx >> 16, zero),
-        jnp.where(kept, gidx & 0xFFFF, zero),
-    ]).reshape(4, BLK).astype(jnp.float32)
-
-    stage_ref[:] = jax.lax.dot_general(
-        rows, onehot, (((1,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32)               # [4, capb]
+    stage_ref[:] = _compact_tile(_quantity_rows(x, gidx, kept), sel, capb)
 
     base = base_ref[0]
     cap = vh_ref.shape[0] - capb                          # slack appended
@@ -263,8 +306,6 @@ def _pack_regions_kernel(num_regions, capb, t_ref, b_ref, x_ref,
             * BLK_COLS
             + jax.lax.broadcasted_iota(jnp.int32, (BLK_ROWS, BLK_COLS), 1))
     mask = jnp.abs(x) >= t_ref[0]
-    vbits = pltpu.bitcast(x, jnp.int32)
-    zero = jnp.zeros_like(vbits)
     blk_start = i * BLK
     blk_end = blk_start + BLK
     cap = vh_ref.shape[1] - capb
@@ -276,19 +317,10 @@ def _pack_regions_kernel(num_regions, capb, t_ref, b_ref, x_ref,
             m = mask_r.astype(jnp.int32)
             pos, _ = _block_prefix(m)
             kept = mask_r & (pos < capb)
-            sel = jnp.where(kept, pos, capb)
+            sel = jnp.where(kept, pos, capb).astype(jnp.float32)
             stored = jnp.sum(kept.astype(jnp.int32))
-            onehot = (sel.reshape(BLK, 1) == jax.lax.broadcasted_iota(
-                jnp.int32, (BLK, capb), 1)).astype(jnp.float32)
-            rows = jnp.stack([
-                jnp.where(kept, vbits >> 16, zero),
-                jnp.where(kept, vbits & 0xFFFF, zero),
-                jnp.where(kept, gidx >> 16, zero),
-                jnp.where(kept, gidx & 0xFFFF, zero),
-            ]).reshape(4, BLK).astype(jnp.float32)
-            stage_ref[:] = jax.lax.dot_general(
-                rows, onehot, (((1,), (0,)), ((), ())),
-                preferred_element_type=jnp.float32)
+            stage_ref[:] = _compact_tile(_quantity_rows(x, gidx, kept),
+                                         sel, capb)
             base_w = jnp.minimum(base_ref[r], cap)
             for j, out in enumerate((vh_ref, vl_ref, ih_ref, il_ref)):
                 copy = pltpu.make_async_copy(
