@@ -90,6 +90,30 @@ def pvary_tree(tree, axis_name: str):
     return jax.tree.map(_pvary, tree)
 
 
+def carry_vma(*arrays, axis_name):
+    """Varying-manual-axes a scan carry must be initialised with under
+    ``shard_map(check_vma=True)``: the union of the inputs' vma plus
+    ``axis_name`` (a ppermute output is always varying over its axis).
+    Shared by the pipeline schedules and ring attention."""
+    vma = {axis_name}
+    for a in arrays:
+        for leaf in jax.tree.leaves(a):
+            vma |= set(getattr(jax.typeof(leaf), "vma", frozenset()))
+    return tuple(sorted(vma))
+
+
+def pvary_to(x, vma):
+    """Mark ``x`` varying over exactly the axes in ``vma`` it isn't yet."""
+    missing = tuple(sorted(set(vma)
+                           - set(getattr(jax.typeof(x), "vma",
+                                         frozenset()))))
+    if not missing:
+        return x
+    if hasattr(lax, "pcast"):
+        return lax.pcast(x, missing, to="varying")
+    return lax.pvary(x, missing)
+
+
 def ppermute_pair(x, axis_name: str, distance: int):
     """Butterfly exchange with the partner at XOR ``distance`` (reference
     gtopk's recursive-halving tree, VGG/allreducer.py:76-172, expressed as a

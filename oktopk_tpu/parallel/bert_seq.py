@@ -130,9 +130,9 @@ def bert_seq_loss(params, batch, cfg: BertConfig, axis_name: str = "seq",
     if data_axis is None:
         nsp_loss = nsp_ce.mean()
     else:
-        nsp_loss = (lax.psum(jnp.sum(nsp_ce), data_axis)
-                    / lax.psum(jnp.asarray(nsp_ce.shape[0], jnp.float32),
-                               data_axis))
+        # equal per-shard batch: mean of per-shard means == global mean,
+        # so no collective is needed for the denominator
+        nsp_loss = lax.pmean(nsp_ce.mean(), data_axis)
     return num / jnp.maximum(den, 1.0) + nsp_loss
 
 

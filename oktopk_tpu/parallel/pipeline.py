@@ -31,6 +31,9 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from oktopk_tpu.comm.primitives import carry_vma as _carry_vma
+from oktopk_tpu.comm.primitives import pvary_to as _pvary_to
+
 
 @partial(jax.custom_vjp, nondiff_argnums=(1,))
 def _bcast_from_last(x, axis_name):
@@ -61,26 +64,6 @@ def _bcast_from_last_bwd(axis_name, _res, ct):
 _bcast_from_last.defvjp(_bcast_from_last_fwd, _bcast_from_last_bwd)
 
 
-def _carry_vma(*arrays, axis_name):
-    """Varying-manual-axes the scan carry must be initialised with under
-    ``shard_map(check_vma=True)``: the union of the inputs' vma plus the
-    pipeline axis (the ppermute output is always varying over it)."""
-    vma = {axis_name}
-    for a in arrays:
-        for leaf in jax.tree.leaves(a):
-            vma |= set(getattr(jax.typeof(leaf), "vma", frozenset()))
-    return tuple(sorted(vma))
-
-
-def _pvary_to(x, vma):
-    missing = tuple(sorted(set(vma)
-                           - set(getattr(jax.typeof(x), "vma",
-                                         frozenset()))))
-    if not missing:
-        return x
-    if hasattr(lax, "pcast"):
-        return lax.pcast(x, missing, to="varying")
-    return lax.pvary(x, missing)
 
 
 def gpipe_apply(stage_fn: Callable, stage_params, microbatches: jnp.ndarray,

@@ -68,9 +68,9 @@ def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
 
     # carry must be varying over every axis the inputs vary over (e.g. a
     # composed data x seq mesh), not just the ring axis
-    from oktopk_tpu.parallel.pipeline import _carry_vma, _pvary_to
-    vma = _carry_vma(q, k, v, kv_mask, axis_name=axis_name)
-    init = jax.tree.map(lambda x: _pvary_to(x, vma),
+    from oktopk_tpu.comm.primitives import carry_vma, pvary_to
+    vma = carry_vma(q, k, v, kv_mask, axis_name=axis_name)
+    init = jax.tree.map(lambda x: pvary_to(x, vma),
                         (m, l, o, k, v, kv_mask))
     (m, l, o, _, _, _), _ = lax.scan(body, init, None, length=P)
     return (o / jnp.maximum(l, 1e-30)[..., None]).astype(q.dtype)
