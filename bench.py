@@ -116,13 +116,19 @@ def step_time_probe(iters=10):
                               ("oktopk", 1, "float32"),
                               ("oktopk_b4", 4, "float32"),
                               ("dense_bf16", 1, "bfloat16")):
-        cfg = TrainConfig(dnn="vgg16", dataset="cifar10", batch_size=16,
-                          lr=0.1, compressor=comp.split("_")[0],
-                          density=0.02, num_workers=1, num_buckets=buckets,
-                          compute_dtype=dt)
-        trainer = Trainer(cfg, mesh=mesh, warmup=False)
-        _ = _time_steps(trainer, batch, 2)        # compile + warm
-        times = _time_steps(trainer, batch, iters)
+        try:
+            cfg = TrainConfig(dnn="vgg16", dataset="cifar10", batch_size=16,
+                              lr=0.1, compressor=comp.split("_")[0],
+                              density=0.02, num_workers=1,
+                              num_buckets=buckets, compute_dtype=dt)
+            trainer = Trainer(cfg, mesh=mesh, warmup=False)
+            _ = _time_steps(trainer, batch, 2)        # compile + warm
+            times = _time_steps(trainer, batch, iters)
+        except Exception as e:
+            # a config that fails to compile/run must not take down the
+            # others' numbers (first contact already succeeded by here)
+            print(f"[bench] {comp} probe failed: {e!r}", file=sys.stderr)
+            continue
         ms = [t * 1e3 for t in times]
         out[f"{comp}_ms"] = statistics.median(ms)
         out[f"{comp}_ms_std"] = statistics.pstdev(ms)
@@ -146,9 +152,10 @@ def step_time_probe(iters=10):
             peak = float(os.environ.get("OKTOPK_PEAK_FLOPS",
                                         DEFAULT_PEAK_FLOPS))
             out["peak_flops_assumed"] = peak   # v5e fp32 unless overridden
-            out["mfu_dense"] = flops_per_step / (out["dense_ms"] / 1e3) / peak
-            out["mfu_oktopk"] = (flops_per_step / (out["oktopk_ms"] / 1e3)
-                                 / peak)
+            for comp in ("dense", "oktopk"):
+                if f"{comp}_ms" in out:
+                    out[f"mfu_{comp}"] = (flops_per_step
+                                          / (out[f"{comp}_ms"] / 1e3) / peak)
     print(f"[bench] {out}", file=sys.stderr)
     return out
 
@@ -157,10 +164,17 @@ def main():
     if "--volume-probe" in sys.argv:
         volume_probe()
         return
+    if "--step-probe" in sys.argv:
+        print("STEP_PROBE " + json.dumps(step_time_probe()))
+        return
 
     here = os.path.dirname(os.path.abspath(__file__))
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
+    # the site plugin's TPU-tunnel registration dials a local relay at
+    # startup; a CPU-only subprocess must never touch it (a down relay
+    # would hang the probe)
+    env["PALLAS_AXON_POOL_IPS"] = ""
     proc = subprocess.run(
         [sys.executable, os.path.abspath(__file__), "--volume-probe"],
         capture_output=True, text=True, env=env, cwd=here, timeout=1800)
@@ -173,18 +187,32 @@ def main():
         print(proc.stderr[-4000:], file=sys.stderr)
         raise RuntimeError("volume probe failed")
 
-    # step-time probe with a bounded retry: first contact with the real
-    # accelerator through the tunnel occasionally times out
+    # step-time probe with a bounded retry, in a subprocess: first contact
+    # with the real accelerator through the tunnel occasionally times out —
+    # and when the tunnel relay is down entirely, jax.devices() BLOCKS
+    # forever inside C (no exception, SIGALRM handlers never run), so the
+    # only reliable deadline is a killable child process. Whatever happens,
+    # the volume JSON line still gets printed.
     steps = {}
+    deadline = int(os.environ.get("OKTOPK_BENCH_STEP_DEADLINE", "900"))
     for attempt in range(2):
         try:
-            steps = step_time_probe()
-            break
-        except Exception as e:
-            print(f"[bench] step-time probe attempt {attempt} failed: {e!r}",
-                  file=sys.stderr)
-            if attempt == 0:
-                time.sleep(20)
+            sp = subprocess.run(
+                [sys.executable, os.path.abspath(__file__), "--step-probe"],
+                capture_output=True, text=True, cwd=here, timeout=deadline)
+            for line in sp.stdout.splitlines():
+                if line.startswith("STEP_PROBE "):
+                    steps = json.loads(line[len("STEP_PROBE "):])
+            # "device" alone means contact succeeded but every config
+            # failed (transient first-compile errors) — retry that too
+            if any(k.endswith("_ms") for k in steps):
+                break
+            print(sp.stderr[-2000:], file=sys.stderr)
+        except subprocess.TimeoutExpired:
+            print(f"[bench] step-time probe attempt {attempt}: no "
+                  f"accelerator contact within {deadline}s", file=sys.stderr)
+        if attempt == 0:
+            time.sleep(20)
 
     value = probe["mean_volume_elems"] * BYTES_PER_ELEM
     dense = probe["dense_volume_elems"] * BYTES_PER_ELEM
