@@ -70,7 +70,11 @@ def volume_probe():
         if i % 4 != 0:   # steady-state predicted steps
             vols.append(float(state.last_volume[0]))
     out = {"n": n, "k": cfg.k, "mean_volume_elems": sum(vols) / len(vols),
-           "dense_volume_elems": 2.0 * n}
+           "dense_volume_elems": 2.0 * n,
+           # bytes per transmitted (index, value) pair: int32 index + the
+           # configured wire value dtype (bf16 wire = 6, f32 wire = 8)
+           "wire_pair_bytes": cfg.wire_pair_bytes,
+           "wire_dtype": cfg.wire_dtype}
     print("VOLUME_PROBE " + json.dumps(out))
 
 
@@ -214,13 +218,19 @@ def main():
         if attempt == 0:
             time.sleep(20)
 
-    value = probe["mean_volume_elems"] * BYTES_PER_ELEM
+    # volume_elems counts transmitted scalars (2 per (index, value) pair);
+    # bytes follow the wire format: int32 index + bf16/f32 value per pair,
+    # dense baseline = 2n f32 values (ring allreduce), no indices
+    pairs = probe["mean_volume_elems"] / 2.0
+    value = pairs * probe.get("wire_pair_bytes", 2 * BYTES_PER_ELEM)
     dense = probe["dense_volume_elems"] * BYTES_PER_ELEM
     record = {
         "metric": "oktopk_sparse_allreduce_volume_bytes_per_step",
         "value": round(value, 1),
         "unit": "bytes/step/worker",
         "vs_baseline": round(dense / value, 2),
+        "volume_elems": round(probe["mean_volume_elems"], 1),
+        "wire_dtype": probe.get("wire_dtype", "float32"),
     }
     for key in ("device", "oktopk_ms", "oktopk_ms_std", "dense_ms",
                 "dense_ms_std", "oktopk_b4_ms", "oktopk_b4_ms_std",

@@ -47,6 +47,27 @@ from oktopk_tpu.ops.topk import k2threshold_method
 from oktopk_tpu.ops.residual import add_residual, update_residual_at_winners
 
 
+def _wire_round(x, cfg: OkTopkConfig):
+    """Round ``x`` through the wire value dtype (identity for float32).
+
+    The TPU-native analogue of the reference's custom float16 MPI datatype
+    (VGG/allreducer.py:20-25): sparse message values travel as bfloat16,
+    indices stay int32, cutting a (index, value) pair from 8 to 6 bytes.
+    Exposed as a roundtrip so the error-feedback residual can capture the
+    rounding error exactly (bf16 -> f32 is exact, so acc - round(acc) is
+    the true wire loss)."""
+    if cfg.wire_dtype == "float32":
+        return x
+    return x.astype(jnp.bfloat16).astype(x.dtype)
+
+
+def _on_wire(x, cfg: OkTopkConfig):
+    """The buffer as it actually crosses the collective."""
+    if cfg.wire_dtype == "float32":
+        return x
+    return x.astype(jnp.bfloat16)
+
+
 def _newton_adapt(thresh, count, count_probe, k, cfg: OkTopkConfig,
                   band_hi=None):
     """Threshold feedback toward the [band_lo*k, band_hi*k] count band.
@@ -169,7 +190,8 @@ def oktopk(grad: jnp.ndarray, state: SparseState, cfg: OkTopkConfig,
     local_count = jnp.sum(mask)
     s_vals, s_idx, s_counts = pack_by_region(
         acc, mask, boundaries, P, cfg.cap_pair, thresh=lt, use_pallas=up)
-    r_vals = all_to_all(s_vals, axis_name)     # [P, cap_pair]
+    r_vals = all_to_all(_on_wire(s_vals, cfg), axis_name) \
+        .astype(acc.dtype)                     # [P, cap_pair]
     r_idx = all_to_all(s_idx, axis_name)
     reduced = scatter_sparse(n, r_vals, r_idx)  # nonzero only in own region
 
@@ -209,7 +231,8 @@ def oktopk(grad: jnp.ndarray, state: SparseState, cfg: OkTopkConfig,
         else:
             cand_mask = (jnp.abs(reduced) >= t_cand) & (reduced != 0.0)
             vals, idx, cand_count = select_mask(reduced, cand_mask, k_cand)
-        gv = all_gather(vals, axis_name)               # [P, k_cand]
+        gv = all_gather(_on_wire(vals, cfg), axis_name) \
+            .astype(acc.dtype)                         # [P, k_cand]
         gi = all_gather(idx, axis_name)
         gt = k2threshold_method(jnp.abs(gv).reshape(-1), min(k, P * k_cand),
                                 cfg.threshold_method,
@@ -233,7 +256,8 @@ def oktopk(grad: jnp.ndarray, state: SparseState, cfg: OkTopkConfig,
         gt_use = state.global_threshold * drift
         gvals, gidx, gcount = select_by_threshold(reduced, gt_use, cap_g,
                                                   use_pallas=up)
-        gv = all_gather(gvals, axis_name)              # [P, cap_g]
+        gv = all_gather(_on_wire(gvals, cfg), axis_name) \
+            .astype(acc.dtype)                         # [P, cap_g]
         gi = all_gather(gidx, axis_name)
         result = scatter_sparse(n, gv, gi)
         # Newton probe count rides the same psum as the realised count —
@@ -255,9 +279,24 @@ def oktopk(grad: jnp.ndarray, state: SparseState, cfg: OkTopkConfig,
     result = result / P
 
     # ---- residual: zero only at indices that made the global result
-    # (reference VGG/allreducer.py:1051-1052).
+    # (reference VGG/allreducer.py:1051-1052). With a bf16 wire the
+    # delivered contribution was the ROUNDED value, so the rounding error
+    # stays in the residual instead of being lost (standard quantization
+    # error feedback): at winners this worker actually sent, keep
+    # acc - round(acc); at winners it didn't select, keep 0 (reference
+    # semantics); elsewhere keep acc. The region owner additionally keeps
+    # the phase-(b) gather rounding of its reduced sums (reduced is
+    # nonzero only in the own region), so total mass is conserved exactly.
     winner_mask = result != 0.0
-    residual = update_residual_at_winners(acc, winner_mask)
+    if cfg.wire_dtype == "float32":
+        residual = update_residual_at_winners(acc, winner_mask)
+    else:
+        quant_err = acc - _wire_round(acc, cfg)
+        residual = jnp.where(winner_mask,
+                             jnp.where(mask, quant_err, 0.0), acc)
+        own_win = winner_mask & (reduced != 0.0)
+        residual = residual + jnp.where(
+            own_win, reduced - _wire_round(reduced, cfg), 0.0)
 
     return result, bump(state, volume=vol_a + vol_b, residual=residual,
                         local_threshold=lt_next, global_threshold=gt_next,

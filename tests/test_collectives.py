@@ -131,7 +131,8 @@ class TestThresholdFamilies:
 
 class TestOkTopk:
     def test_full_density_equals_dense(self, mesh8, grads):
-        cfg = make_cfg(density=1.0)
+        # f32 wire: density=1 must reproduce the dense mean bit-for-bit
+        cfg = make_cfg(density=1.0, wire_dtype="float32")
         step = build_allreduce_step("oktopk", cfg, mesh8, warmup=False)
         out, _ = step(grads, batched_init_state(cfg))
         want = np.asarray(grads).mean(0)
@@ -207,7 +208,8 @@ class TestOkTopk:
         assert b[P // 2] < N // 2 + N // 8
 
     def test_residual_keeps_unsent_mass(self, mesh8, grads):
-        cfg = make_cfg(density=0.05)
+        # f32 wire = the reference's exact residual semantics
+        cfg = make_cfg(density=0.05, wire_dtype="float32")
         step = build_allreduce_step("oktopk", cfg, mesh8, warmup=False)
         out, state = step(grads, batched_init_state(cfg))
         res = np.asarray(state.residual)
@@ -217,6 +219,47 @@ class TestOkTopk:
             # winners zeroed, everything else kept (VGG/allreducer.py:1051-1052)
             assert np.allclose(res[r][won], 0.0)
             np.testing.assert_allclose(res[r][~won], g[r][~won], atol=1e-6)
+
+
+class TestWireFormat:
+    """bf16 message values (the reference's float16 MPI datatype role,
+    VGG/allreducer.py:20-25) with quantization error feedback."""
+
+    def test_pair_bytes(self):
+        assert make_cfg(wire_dtype="bfloat16").wire_pair_bytes == 6
+        assert make_cfg(wire_dtype="float32").wire_pair_bytes == 8
+
+    def test_quantization_error_feedback(self, mesh8, grads):
+        cfg = make_cfg(density=0.05, wire_dtype="bfloat16")
+        step = build_allreduce_step("oktopk", cfg, mesh8, warmup=False)
+        out, state = step(grads, batched_init_state(cfg))
+        res = np.asarray(state.residual)
+        g = np.asarray(grads)
+        won = np.asarray(out[0]) != 0.0
+        mean = np.asarray(out[0])
+        for r in range(P):
+            # at winners the residual is rounding-scale (bf16 eps ~ 2^-8 of
+            # the local value, plus the owner's gather compensation which
+            # scales with the P-worker reduced sum = P * mean), never the
+            # full value; off winners the full mass is kept
+            bound = 1e-2 * (np.abs(g[r][won]) + P * np.abs(mean[won])) + 1e-6
+            assert np.all(np.abs(res[r][won]) <= bound)
+            np.testing.assert_allclose(res[r][~won], g[r][~won], atol=1e-6)
+
+    def test_bf16_wire_tracks_f32_result(self, mesh8, grads):
+        outs = {}
+        for wd in ("float32", "bfloat16"):
+            cfg = make_cfg(density=0.05, wire_dtype=wd)
+            step = build_allreduce_step("oktopk", cfg, mesh8, warmup=False)
+            out, _ = step(grads, batched_init_state(cfg))
+            outs[wd] = np.asarray(out[0])
+        a, b = outs["float32"], outs["bfloat16"]
+        # same winner support (thresholds are computed from rounded values
+        # but the selection bands are far wider than bf16 resolution)
+        agree = np.mean((a != 0) == (b != 0))
+        assert agree > 0.99
+        both = (a != 0) & (b != 0)
+        np.testing.assert_allclose(a[both], b[both], rtol=2e-2, atol=1e-5)
 
 
 class TestWarmup:
