@@ -20,7 +20,8 @@ from oktopk_tpu.collectives.state import SparseState, bump
 from oktopk_tpu.comm import all_gather, psum
 from oktopk_tpu.config import OkTopkConfig
 from oktopk_tpu.ops import gaussian_threshold, scatter_sparse, select_by_threshold
-from oktopk_tpu.ops.residual import add_residual, update_residual_at_selection
+from oktopk_tpu.ops.residual import add_residual
+from oktopk_tpu.collectives.wire import on_wire, residual_after_selection
 
 
 def gaussian_k(grad: jnp.ndarray, state: SparseState, cfg: OkTopkConfig,
@@ -33,9 +34,9 @@ def gaussian_k(grad: jnp.ndarray, state: SparseState, cfg: OkTopkConfig,
     vals, idx, count = select_by_threshold(
         acc, t, cap, use_pallas=bool(cfg.use_pallas))
     packed_mask = jnp.zeros((n,), bool).at[idx].set(True, mode="drop")
-    residual = update_residual_at_selection(acc, packed_mask)
+    residual = residual_after_selection(acc, packed_mask, cfg)
 
-    gv = all_gather(vals, axis_name)          # [P, cap]
+    gv = all_gather(on_wire(vals, cfg), axis_name).astype(acc.dtype)
     gi = all_gather(idx, axis_name)
     result = scatter_sparse(n, gv, gi) / P
 

@@ -25,7 +25,12 @@ from oktopk_tpu.comm import psum
 from oktopk_tpu.comm.primitives import ppermute_pair
 from oktopk_tpu.config import OkTopkConfig
 from oktopk_tpu.ops import exact_topk, scatter_sparse
-from oktopk_tpu.ops.residual import add_residual, update_residual_at_selection
+from oktopk_tpu.ops.residual import add_residual
+from oktopk_tpu.collectives.wire import (
+    on_wire,
+    residual_after_selection,
+    wire_round,
+)
 
 
 def gtopk(grad: jnp.ndarray, state: SparseState, cfg: OkTopkConfig,
@@ -36,12 +41,20 @@ def gtopk(grad: jnp.ndarray, state: SparseState, cfg: OkTopkConfig,
     acc = add_residual(grad, state.residual)
     vals, idx = exact_topk(acc, k)
     sel_mask = jnp.zeros((n,), bool).at[idx].set(True)
-    residual = update_residual_at_selection(acc, sel_mask)
+    residual = residual_after_selection(acc, sel_mask, cfg)
 
     rounds = P.bit_length() - 1
     d = 1
     for _ in range(rounds):
-        pv = ppermute_pair(vals, axis_name, d)
+        # round own values through the wire dtype BEFORE merging so both
+        # partners merge identical multisets — otherwise each rank would
+        # combine its own f32 values with the partner's rounded ones and
+        # the all-ranks-identical-result invariant breaks. The first
+        # round's loss is captured by the selection residual above;
+        # later rounds re-round merged sums (collectives/wire.py).
+        vals = wire_round(vals, cfg)
+        pv = ppermute_pair(on_wire(vals, cfg), axis_name, d) \
+            .astype(acc.dtype)            # lossless: vals already rounded
         pi = ppermute_pair(idx, axis_name, d)
         merged = scatter_sparse(n, jnp.concatenate([vals, pv]),
                                 jnp.concatenate([idx, pi]))

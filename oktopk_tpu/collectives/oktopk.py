@@ -44,28 +44,11 @@ from oktopk_tpu.ops import (
     select_mask,
 )
 from oktopk_tpu.ops.topk import k2threshold_method
-from oktopk_tpu.ops.residual import add_residual, update_residual_at_winners
-
-
-def _wire_round(x, cfg: OkTopkConfig):
-    """Round ``x`` through the wire value dtype (identity for float32).
-
-    The TPU-native analogue of the reference's custom float16 MPI datatype
-    (VGG/allreducer.py:20-25): sparse message values travel as bfloat16,
-    indices stay int32, cutting a (index, value) pair from 8 to 6 bytes.
-    Exposed as a roundtrip so the error-feedback residual can capture the
-    rounding error exactly (bf16 -> f32 is exact, so acc - round(acc) is
-    the true wire loss)."""
-    if cfg.wire_dtype == "float32":
-        return x
-    return x.astype(jnp.bfloat16).astype(x.dtype)
-
-
-def _on_wire(x, cfg: OkTopkConfig):
-    """The buffer as it actually crosses the collective."""
-    if cfg.wire_dtype == "float32":
-        return x
-    return x.astype(jnp.bfloat16)
+from oktopk_tpu.ops.residual import add_residual
+from oktopk_tpu.collectives.wire import (
+    on_wire as _on_wire,
+    residual_after_winners,
+)
 
 
 def _newton_adapt(thresh, count, count_probe, k, cfg: OkTopkConfig,
@@ -279,24 +262,10 @@ def oktopk(grad: jnp.ndarray, state: SparseState, cfg: OkTopkConfig,
     result = result / P
 
     # ---- residual: zero only at indices that made the global result
-    # (reference VGG/allreducer.py:1051-1052). With a bf16 wire the
-    # delivered contribution was the ROUNDED value, so the rounding error
-    # stays in the residual instead of being lost (standard quantization
-    # error feedback): at winners this worker actually sent, keep
-    # acc - round(acc); at winners it didn't select, keep 0 (reference
-    # semantics); elsewhere keep acc. The region owner additionally keeps
-    # the phase-(b) gather rounding of its reduced sums (reduced is
-    # nonzero only in the own region), so total mass is conserved exactly.
+    # (reference VGG/allreducer.py:1051-1052); under the bf16 wire the
+    # rounding errors stay in the residual (collectives/wire.py).
     winner_mask = result != 0.0
-    if cfg.wire_dtype == "float32":
-        residual = update_residual_at_winners(acc, winner_mask)
-    else:
-        quant_err = acc - _wire_round(acc, cfg)
-        residual = jnp.where(winner_mask,
-                             jnp.where(mask, quant_err, 0.0), acc)
-        own_win = winner_mask & (reduced != 0.0)
-        residual = residual + jnp.where(
-            own_win, reduced - _wire_round(reduced, cfg), 0.0)
+    residual = residual_after_winners(acc, winner_mask, mask, reduced, cfg)
 
     return result, bump(state, volume=vol_a + vol_b, residual=residual,
                         local_threshold=lt_next, global_threshold=gt_next,

@@ -24,7 +24,8 @@ from oktopk_tpu.ops import (
     select_by_threshold,
 )
 from oktopk_tpu.ops.topk import k2threshold_method
-from oktopk_tpu.ops.residual import add_residual, update_residual_at_selection
+from oktopk_tpu.ops.residual import add_residual
+from oktopk_tpu.collectives.wire import on_wire, residual_after_selection
 
 
 def _adapt_threshold(thresh, count, k, cfg: OkTopkConfig):
@@ -45,9 +46,9 @@ def topk_a(grad: jnp.ndarray, state: SparseState, cfg: OkTopkConfig,
     acc = add_residual(grad, state.residual)
     vals, idx = exact_topk(acc, k)
     sel_mask = jnp.zeros((n,), bool).at[idx].set(True)
-    residual = update_residual_at_selection(acc, sel_mask)
+    residual = residual_after_selection(acc, sel_mask, cfg)
 
-    gv = all_gather(vals, axis_name)          # [P, k]
+    gv = all_gather(on_wire(vals, cfg), axis_name).astype(acc.dtype)  # [P, k]
     gi = all_gather(idx, axis_name)           # [P, k]
     result = scatter_sparse(n, gv, gi) / P
 
@@ -88,9 +89,9 @@ def topk_a_opt(grad: jnp.ndarray, state: SparseState, cfg: OkTopkConfig,
     vals, idx, count = select_by_threshold(
         acc, lt, cap, use_pallas=bool(cfg.use_pallas))
     packed_mask = jnp.zeros((n,), bool).at[idx].set(True, mode="drop")
-    residual = update_residual_at_selection(acc, packed_mask)
+    residual = residual_after_selection(acc, packed_mask, cfg)
 
-    gv = all_gather(vals, axis_name)          # [P, cap]
+    gv = all_gather(on_wire(vals, cfg), axis_name).astype(acc.dtype)
     gi = all_gather(idx, axis_name)
     result = scatter_sparse(n, gv, gi) / P
 

@@ -30,7 +30,8 @@ from oktopk_tpu.ops import (
 )
 from oktopk_tpu.ops.select import select_nonzero
 from oktopk_tpu.ops.topk import k2threshold_method
-from oktopk_tpu.ops.residual import add_residual, update_residual_at_winners
+from oktopk_tpu.ops.residual import add_residual
+from oktopk_tpu.collectives.wire import on_wire, residual_after_winners
 
 
 def _split_allreduce(acc, lt, state: SparseState, cfg: OkTopkConfig,
@@ -46,7 +47,7 @@ def _split_allreduce(acc, lt, state: SparseState, cfg: OkTopkConfig,
     s_vals, s_idx, s_counts = pack_by_region(
         acc, mask, boundaries, P, cfg.cap_pair, thresh=lt,
         use_pallas=bool(cfg.use_pallas))
-    r_vals = all_to_all(s_vals, axis_name)
+    r_vals = all_to_all(on_wire(s_vals, cfg), axis_name).astype(acc.dtype)
     r_idx = all_to_all(s_idx, axis_name)
     reduced = scatter_sparse(n, r_vals, r_idx)
 
@@ -63,30 +64,34 @@ def _split_allreduce(acc, lt, state: SparseState, cfg: OkTopkConfig,
     def sparse_gather():
         gvals, gidx, gcount = select_nonzero(
             reduced, cap_g, use_pallas=bool(cfg.use_pallas))
-        gv = all_gather(gvals, axis_name)
+        gv = all_gather(on_wire(gvals, cfg), axis_name).astype(acc.dtype)
         gi = all_gather(gidx, axis_name)
         result = scatter_sparse(n, gv, gi)
         total = psum(gcount, axis_name)
         vol = 2.0 * gcount + 2.0 * (total - gcount)
-        return pvary_tree((result, vol), axis_name)
+        return pvary_tree((result, vol, jnp.float32(1.0)), axis_name)
 
     def dense_gather():
         # Regions are disjoint, so psum of the partials is the dense gather
-        # the reference falls back to (VGG/allreducer.py:1318-1351).
+        # the reference falls back to (VGG/allreducer.py:1318-1351). The
+        # psum is NOT wire-rounded, so the owner's gather-rounding
+        # compensation must be off (third element 0.0).
         return pvary_tree(
-            (psum(reduced, axis_name), jnp.asarray(2.0 * n, jnp.float32)),
+            (psum(reduced, axis_name), jnp.asarray(2.0 * n, jnp.float32),
+             jnp.float32(0.0)),
             axis_name)
 
     if dense_fallback:
-        result, vol_b = lax.cond(
+        result, vol_b, gather_rounded = lax.cond(
             total_nnz >= cfg.sa_dense_fallback_ratio * n,
             dense_gather, sparse_gather)
     else:
-        result, vol_b = sparse_gather()
+        result, vol_b, gather_rounded = sparse_gather()
 
     result = result / P
     winner_mask = result != 0.0
-    residual = update_residual_at_winners(acc, winner_mask)
+    residual = residual_after_winners(acc, winner_mask, mask, reduced, cfg,
+                                      owner_scale=gather_rounded)
     return result, residual, vol_a + vol_b, local_count, total_nnz
 
 

@@ -1,0 +1,85 @@
+"""bf16 wire format for sparse message values, shared by all algorithms.
+
+The TPU-native analogue of the reference's custom float16 MPI datatype +
+sum op (VGG/allreducer.py:20-25): message VALUES travel as bfloat16 while
+indices stay int32, cutting an (index, value) pair from 8 to 6 bytes.
+``OkTopkConfig.wire_dtype`` selects it; "float32" restores the
+reference-exact semantics.
+
+The rounding error is folded back into the error-feedback residual
+(standard quantization error feedback), so quantized mass is delivered on
+a later step rather than lost:
+
+- selection-residual algorithms (topkA family, gaussiank, gtopk's first
+  hop): the residual keeps ``acc - round(acc)`` at selected slots instead
+  of 0 (``residual_after_selection``);
+- winner-residual algorithms (oktopk, topkSA/gaussiankSA): senders keep
+  ``acc - round(acc)`` at winners they actually sent, and the region owner
+  additionally keeps the phase-(b) gather rounding of its reduced sums
+  (``residual_after_winners``), conserving total mass exactly.
+
+Multi-hop merges (gtopk's butterfly) re-round intermediate SUMS; that
+error is not attributable to any single worker's residual and stays
+unrecovered (bounded by bf16 eps per hop) — but every rank must round its
+own buffer before each exchange so partners merge identical multisets and
+the all-ranks-identical-result invariant survives.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from oktopk_tpu.config import OkTopkConfig
+from oktopk_tpu.ops.residual import (
+    update_residual_at_selection,
+    update_residual_at_winners,
+)
+
+
+def on_wire(x, cfg: OkTopkConfig):
+    """The value buffer as it actually crosses the collective."""
+    if cfg.wire_dtype == "float32":
+        return x
+    return x.astype(jnp.bfloat16)
+
+
+def wire_round(x, cfg: OkTopkConfig):
+    """Round ``x`` through the wire dtype (identity for float32).
+
+    bf16 -> f32 is exact, so ``acc - wire_round(acc)`` is the true wire
+    loss and error feedback can capture it exactly."""
+    if cfg.wire_dtype == "float32":
+        return x
+    return x.astype(jnp.bfloat16).astype(x.dtype)
+
+
+def residual_after_selection(acc, sel_mask, cfg: OkTopkConfig):
+    """update_residual_at_selection (reference VGG/compression.py:343) plus
+    quantization error feedback: selected slots keep the wire rounding
+    error instead of 0."""
+    if cfg.wire_dtype == "float32":
+        return update_residual_at_selection(acc, sel_mask)
+    return jnp.where(sel_mask, acc - wire_round(acc, cfg), acc)
+
+
+def residual_after_winners(acc, winner_mask, sent_mask, reduced,
+                           cfg: OkTopkConfig, owner_scale=None):
+    """update_residual_at_winners (reference VGG/allreducer.py:1051-1052)
+    plus quantization error feedback.
+
+    At winners this worker sent (``sent_mask``), keep ``acc - round(acc)``;
+    at winners it never selected, keep 0 (reference semantics: that mass is
+    discarded); elsewhere keep acc. The region owner — identified by
+    ``reduced != 0`` since the phase-(a) scatter leaves ``reduced`` nonzero
+    only in the own region — additionally keeps the phase-(b) gather
+    rounding of its reduced sums. ``owner_scale`` (0/1) disables that term
+    when the gather was NOT rounded (topkSA's dense psum fallback)."""
+    if cfg.wire_dtype == "float32":
+        return update_residual_at_winners(acc, winner_mask)
+    quant_err = acc - wire_round(acc, cfg)
+    res = jnp.where(winner_mask, jnp.where(sent_mask, quant_err, 0.0), acc)
+    comp = jnp.where(winner_mask & (reduced != 0.0),
+                     reduced - wire_round(reduced, cfg), 0.0)
+    if owner_scale is not None:
+        comp = comp * owner_scale
+    return res + comp

@@ -34,6 +34,10 @@ def parse_args(argv=None):
     p.add_argument("--compressor", default="oktopk")
     p.add_argument("--compute-dtype", default="float32",
                    choices=["float32", "bfloat16"])
+    p.add_argument("--wire-dtype", default="bfloat16",
+                   choices=["bfloat16", "float32"],
+                   help="sparse message VALUE dtype on the wire "
+                        "(float32 = reference-exact uncompressed)")
     p.add_argument("--density", type=float, default=0.01)
     p.add_argument("--pipeline-stages", type=int, default=1,
                    help="pipeline depth: split the encoder over a "
@@ -103,7 +107,8 @@ def main(argv=None):
     algo_cfg = OkTopkConfig(
         warmup_steps=0, local_recompute_every=128,
         global_recompute_every=128, repartition_every=64,
-        local_adapt_scale=1.025, global_adapt_scale=1.036)
+        local_adapt_scale=1.025, global_adapt_scale=1.036,
+        wire_dtype=args.wire_dtype)
 
     trainer = Trainer(cfg, algo_cfg=algo_cfg)
     preempt = None

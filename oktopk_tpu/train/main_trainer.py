@@ -38,6 +38,11 @@ def parse_args(argv=None):
                    choices=["float32", "bfloat16"],
                    help="model computation dtype (bf16 = 2x MXU; params/"
                         "grads/collective stay f32 - the apex-amp role)")
+    p.add_argument("--wire-dtype", default="bfloat16",
+                   choices=["bfloat16", "float32"],
+                   help="sparse message VALUE dtype on the wire (the "
+                        "reference's fp16 MPI datatype role; float32 = "
+                        "reference-exact uncompressed messages)")
     p.add_argument("--num-buckets", type=int, default=1,
                    help="reverse-layer-order gradient buckets, one sparse "
                         "collective each (reference <=640MiB bucketing, "
@@ -119,7 +124,8 @@ def main(argv=None):
         os.path.join(args.logdir, slug, f"rank{jax.process_index()}.log"))
     logger.info("experiment %s on %d devices", slug, len(jax.devices()))
 
-    algo_cfg = OkTopkConfig(sigma_scale=args.sigma_scale)
+    algo_cfg = OkTopkConfig(sigma_scale=args.sigma_scale,
+                            wire_dtype=args.wire_dtype)
     if args.warmup_steps is not None:
         algo_cfg = algo_cfg.replace(warmup_steps=args.warmup_steps)
 
