@@ -1,0 +1,134 @@
+"""Step-phase breakdown: where does a VGG-16 oktopk train step spend time?
+
+The reference answers this with per-phase wall-clock dicts inside its
+allreducer thread (_merge/_compression/_allreduce/... timers,
+VGG/allreducer.py:256-262,379-439). Under XLA the phases fuse into one
+compiled program, so the breakdown comes from timing *separately compiled*
+subprograms on the same data instead:
+
+  fwd_bwd   — loss + gradient only (the pure model compute path)
+  select    — the full sparse allreduce on a same-sized flat gradient
+              (threshold + pack + exchange + gather + scatter)
+  threshold — just the exact k-th-value recompute (count-bisection)
+  pack      — just the fixed-capacity selection/compaction
+  full      — the actual fused train step (what bench.py times)
+
+full < fwd_bwd + select is expected (XLA overlaps/fuses); a full that is
+dominated by `select`'s components reproduces the round-2 diagnosis
+(selection-bound step), and the Pallas-vs-portable delta is read directly
+off `pack`.
+
+Writes one JSON line; run on the real chip for BENCH profile notes, or on
+CPU for smoke. Usage:  python scripts/profile_step.py [--iters 10]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import statistics
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _med_ms(fn, sync, iters):
+    ts = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        sync(fn())
+        ts.append((time.perf_counter() - t0) * 1e3)
+    return statistics.median(ts)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--iters", type=int, default=10)
+    ap.add_argument("--dnn", default="vgg16",
+                    help="model for the step probes (mnistnet for CPU smoke)")
+    ap.add_argument("--batch-size", type=int, default=16)
+    ap.add_argument("--density", type=float, default=0.02)
+    ap.add_argument("--use-pallas", default=None,
+                    choices=["true", "false"],
+                    help="default: resolve from backend")
+    ap.add_argument("--platform", default=None,
+                    help="jax platform override (e.g. cpu) — env vars alone "
+                         "cannot undo the site plugin's backend selection "
+                         "(see tests/conftest.py)")
+    args = ap.parse_args()
+
+    import jax
+    if args.platform:
+        jax.config.update("jax_platforms", args.platform)
+    import jax.numpy as jnp
+    import numpy as np
+
+    from oktopk_tpu.collectives.api import batched_init_state, \
+        build_allreduce_step
+    from oktopk_tpu.comm.mesh import get_mesh
+    from oktopk_tpu.config import OkTopkConfig, TrainConfig
+    from oktopk_tpu.data.synthetic import synthetic_batch
+    from oktopk_tpu.ops.compaction import resolve_use_pallas
+    from oktopk_tpu.ops.select import select_by_threshold
+    from oktopk_tpu.ops.topk import k2threshold_method
+    from oktopk_tpu.train.trainer import Trainer
+
+    dev = jax.devices()[0]
+    mesh = get_mesh((1,), ("data",), devices=[dev])
+    rng = np.random.RandomState(0)
+    batch = jax.device_put(synthetic_batch(args.dnn, args.batch_size, rng))
+
+    def sync(x):
+        jax.tree.map(lambda a: np.asarray(a), x)
+
+    out = {"device": dev.platform, "iters": args.iters}
+
+    # --- full fused train step + fwd/bwd-only (dense optimizer ~ compute)
+    for comp, key in (("oktopk", "full_ms"), ("dense", "fwd_bwd_dense_ms")):
+        cfg = TrainConfig(dnn=args.dnn, dataset="cifar10",
+                          batch_size=args.batch_size,
+                          lr=0.1, compressor=comp, density=args.density,
+                          num_workers=1)
+        tr = Trainer(cfg, mesh=mesh, warmup=False)
+        fn = lambda tr=tr: tr.train_step(batch)
+        _med_ms(fn, sync, 2)
+        out[key] = _med_ms(fn, sync, args.iters)
+        n = tr.algo_cfg.n
+
+    # --- isolated sparse-allreduce on a same-sized gradient
+    acfg = OkTopkConfig(n=n, num_workers=1, density=args.density,
+                        warmup_steps=0)
+    if args.use_pallas is not None:
+        acfg = acfg.replace(use_pallas=args.use_pallas == "true")
+    acfg = resolve_use_pallas(acfg, mesh)
+    out["use_pallas"] = bool(acfg.use_pallas)
+    step = build_allreduce_step("oktopk", acfg, mesh, warmup=False)
+    g = jax.device_put(jnp.asarray(rng.randn(1, n).astype(np.float32)))
+    state = batched_init_state(acfg)
+    _, state = step(g, state)                 # compile + enter steady state
+    out["select_ms"] = _med_ms(lambda: step(g, state)[0], sync, args.iters)
+
+    # --- components: exact threshold, and the capacity pack
+    k = acfg.k
+    gf = g[0]
+    thr_fn = jax.jit(lambda x: k2threshold_method(jnp.abs(x), k,
+                                                  acfg.threshold_method,
+                                                  acfg.bisect_iters))
+    sync(thr_fn(gf))
+    out["threshold_ms"] = _med_ms(lambda: thr_fn(gf), sync, args.iters)
+    t = thr_fn(gf)
+
+    pk = jax.jit(lambda x: select_by_threshold(
+        x, t, acfg.cap_gather, use_pallas=bool(acfg.use_pallas)))
+    sync(pk(gf))
+    out["pack_ms"] = _med_ms(lambda: pk(gf), sync, args.iters)
+
+    out = {k2: (round(v, 3) if isinstance(v, float) else v)
+           for k2, v in out.items()}
+    print("PROFILE " + json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

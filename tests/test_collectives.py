@@ -255,6 +255,12 @@ class TestWireFormat:
             cfg = make_cfg(density=0.05, wire_dtype=wd)
             step = build_allreduce_step(name, cfg, mesh8, warmup=False)
             out, _ = step(grads, batched_init_state(cfg))
+            # every rank must hold the identical result — for gtopk this is
+            # the butterfly invariant that breaks if ranks merge their own
+            # unrounded values with partners' rounded ones
+            for r in range(1, P):
+                np.testing.assert_array_equal(np.asarray(out[r]),
+                                              np.asarray(out[0]))
             outs[wd] = np.asarray(out[0])
         a, b = outs["float32"], outs["bfloat16"]
         # same winner support (thresholds are computed from rounded values
@@ -337,6 +343,27 @@ class TestTopkSA:
         want = np.asarray(grads).mean(0)
         np.testing.assert_allclose(np.asarray(out[0]), want, atol=1e-5)
         assert float(state.last_volume[0]) >= 2.0 * N
+
+    def test_dense_fallback_bf16_residual_not_double_counted(self, mesh8,
+                                                             grads):
+        """density=1.0 under the bf16 wire triggers the dense psum fallback,
+        whose gather is NOT rounded: the owner compensation must be off
+        (owner_scale=0) or residual mass double-counts. With every element
+        selected and delivered, residuals must stay at rounding scale."""
+        cfg = make_cfg(density=1.0, wire_dtype="bfloat16")
+        step = build_allreduce_step("topkSA", cfg, mesh8, warmup=False)
+        out, state = step(grads, batched_init_state(cfg))
+        assert float(state.last_volume[0]) >= 2.0 * N   # fallback taken
+        g = np.asarray(grads)
+        res = np.asarray(state.residual)
+        mean = np.asarray(out[0])
+        # result tracks the dense mean up to phase-(a) bf16 rounding
+        np.testing.assert_allclose(mean, g.mean(0), rtol=1e-2, atol=1e-2)
+        # residual = acc - round(acc) only; a spurious owner term would add
+        # reduced-sum-scale (~P x) mass on the owner's region
+        for r in range(P):
+            rt = g[r].astype(jnp.bfloat16).astype(np.float32)
+            np.testing.assert_allclose(res[r], g[r] - rt, atol=1e-6)
 
     def test_gaussianksa_runs(self, mesh8, grads):
         cfg = make_cfg(density=0.05)
