@@ -1,0 +1,277 @@
+"""Expert-parallel Mixture-of-Experts BERT over an ``expert`` mesh axis.
+
+The reference has no expert parallelism (SURVEY.md §2.3 — absent); this is
+a TPU-side extension completing the mesh-axes story (data x pipe x seq x
+model x expert). Each encoder layer's FFN becomes a Switch-style top-1
+MoE in the GShard formulation — the TPU-canonical shape where routing is
+einsums over a fixed-capacity dispatch tensor and the cross-device hop is
+ONE ``lax.all_to_all`` each way:
+
+  tokens [n, H] -> gate top-1 -> dispatch one-hot [n, E, C]
+    -> einsum dispatch: expert inputs [E, C, H]
+    -> all_to_all over the leading expert-group dim (tokens ride ICI to
+       the rank owning their expert; E = P * E_local)
+    -> batched expert FFN einsum [E_local, P*C, H]
+    -> all_to_all back -> combine einsum weighted by the gate prob.
+
+Fixed capacity ``C`` per (expert, source rank) with overflow dropped is
+the same static-shape discipline as the sparse collectives' capacity
+buffers (ops/select.py): a dropped token contributes 0 and passes through
+the residual connection (standard Switch behavior). The Switch
+load-balance auxiliary loss keeps routing spread.
+
+The batch is sharded over the ``expert`` axis (data and expert
+parallelism folded on one axis, as in Switch), attention and everything
+outside the FFNs stay replicated. ``experts_from_dense`` tiles a dense
+``BertForPreTraining`` FFN into E identical experts, making the MoE loss
+equivalence-testable against the single-module oracle: with identical
+experts and no overflow, ANY routing reproduces the dense FFN exactly.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from oktopk_tpu.models.bert import BertConfig
+from oktopk_tpu.parallel.bert_seq import _dense, _layer_norm
+
+
+@dataclasses.dataclass(frozen=True)
+class MoEConfig:
+    num_experts: int = 4
+    capacity_factor: float = 1.25   # C = ceil(n * factor / E) per rank
+    aux_weight: float = 0.01        # Switch load-balance loss weight
+
+
+def experts_from_dense(params, num_experts: int,
+                       gate_scale: float = 0.0, seed: int = 0):
+    """(single-module params) -> (moe_stack, shared).
+
+    Every layer's intermediate/output FFN is tiled into ``num_experts``
+    identical experts (leading [E] axis) plus a gate; everything else goes
+    to ``shared``. With the default zero gate, identical experts + no
+    overflow make the MoE forward equal the dense forward for any routing
+    — the equivalence oracle. REAL training must pass ``gate_scale > 0``:
+    a zero gate gives uniform probs, argmax breaks the tie toward expert 0
+    for every token, and the default capacity factor then drops most of
+    the batch while experts 1..E-1 starve (Switch/GShard init the router
+    with small noise for exactly this reason)."""
+    gate_rng = jax.random.PRNGKey(seed)
+    enc = params["bert"]["encoder"]
+    moe_layers, sh_layers = {}, {}
+    for name, lp in enc.items():
+        tile = lambda x: jnp.broadcast_to(
+            x[None], (num_experts,) + x.shape).copy()
+        hidden = lp["intermediate"]["kernel"].shape[0]
+        moe_layers[name] = {
+            "wi": tile(lp["intermediate"]["kernel"]),   # [E, H, F]
+            "bi": tile(lp["intermediate"]["bias"]),     # [E, F]
+            "wo": tile(lp["output"]["kernel"]),         # [E, F, H]
+            "bo": tile(lp["output"]["bias"]),           # [E, H]
+        }
+        gate_rng, sub = jax.random.split(gate_rng)
+        gate = gate_scale * jax.random.normal(
+            sub, (hidden, num_experts), jnp.float32) if gate_scale else \
+            jnp.zeros((hidden, num_experts), jnp.float32)
+        sh_layers[name] = {
+            "attention": lp["attention"],
+            "attention_ln": lp["attention_ln"],
+            "output_ln": lp["output_ln"],
+            "gate": gate,
+        }
+    shared = {
+        "embeddings": params["bert"]["embeddings"],
+        "pooler": params["bert"]["pooler"],
+        "mlm_dense": params["mlm_dense"],
+        "mlm_ln": params["mlm_ln"],
+        "mlm_bias": params["mlm_bias"],
+        "nsp": params["nsp"],
+        "layers": sh_layers,
+    }
+    return moe_layers, shared
+
+
+def _attention(p, x, attn_mask):
+    """Plain replicated multi-head attention (flax param layout, as
+    models/bert.py)."""
+    def proj(pp):
+        return jnp.einsum("bte,ehd->bthd", x, pp["kernel"]) + pp["bias"]
+
+    q, k, v = proj(p["query"]), proj(p["key"]), proj(p["value"])
+    d = q.shape[-1]
+    s = jnp.einsum("bthd,bshd->bhts", q * (d ** -0.5), k)
+    s = jnp.where(attn_mask, s, jnp.asarray(-1e30, s.dtype))
+    o = jnp.einsum("bhts,bshd->bthd", jax.nn.softmax(s, axis=-1), v)
+    return jnp.einsum("bthd,hde->bte", o, p["out"]["kernel"]) \
+        + p["out"]["bias"]
+
+
+def moe_ffn(experts_local, gate, x, mcfg: MoEConfig, axis_name):
+    """GShard top-1 MoE FFN inside ``shard_map``.
+
+    experts_local: this rank's expert stack (leaves [E_local, ...]);
+    gate [H, E] replicated; x [b, T, H] this rank's batch shard. Returns
+    (y [b, T, H], aux_loss scalar — the global Switch load-balance term).
+    """
+    Pn = lax.axis_size(axis_name)
+    E = mcfg.num_experts
+    e_local = experts_local["wi"].shape[0]
+    assert e_local * Pn == E, (e_local, Pn, E)
+    b, T, H = x.shape
+    n = b * T
+    C = max(1, int(-(-n * mcfg.capacity_factor // E)))
+
+    xt = x.reshape(n, H)
+    logits = jnp.einsum("nh,he->ne", xt, gate)
+    probs = jax.nn.softmax(logits, axis=-1)
+    e_star = jnp.argmax(probs, axis=-1)                     # [n]
+    g = jnp.take_along_axis(probs, e_star[:, None], 1)[:, 0]
+
+    # Switch load-balance aux: E * sum_e f_e * p_e, f/p averaged globally
+    onehot = jax.nn.one_hot(e_star, E, dtype=xt.dtype)      # [n, E]
+    f_e = lax.pmean(jnp.mean(onehot, axis=0), axis_name)
+    p_e = lax.pmean(jnp.mean(probs, axis=0), axis_name)
+    aux = E * jnp.sum(f_e * p_e)
+
+    # position of each token within its expert's capacity (per source rank)
+    pos = jnp.cumsum(onehot, axis=0) - onehot               # [n, E] excl.
+    pos = jnp.sum(pos * onehot, axis=-1).astype(jnp.int32)  # [n]
+    keep = pos < C
+    # dispatch one-hot [n, E, C]: token n -> (its expert, its slot)
+    disp = (onehot * keep[:, None])[:, :, None] \
+        * jax.nn.one_hot(pos, C, dtype=xt.dtype)[:, None, :]
+
+    xin = jnp.einsum("nec,nh->ech", disp, xt)               # [E, C, H]
+    # ship capacity blocks to expert owners: [E=P*El, C, H] -> regroup so
+    # the all_to_all splits the leading dim across ranks
+    xin = all_to_all_leading(xin, Pn, e_local, axis_name)   # [P, El, C, H]
+    xin = xin.transpose(1, 0, 2, 3).reshape(e_local, Pn * C, H)
+
+    h = jnp.einsum("ekh,ehf->ekf", xin, experts_local["wi"]) \
+        + experts_local["bi"][:, None]
+    h = jax.nn.gelu(h, approximate=False)
+    y = jnp.einsum("ekf,efh->ekh", h, experts_local["wo"]) \
+        + experts_local["bo"][:, None]
+
+    y = y.reshape(e_local, Pn, C, H).transpose(1, 0, 2, 3)  # [P, El, C, H]
+    y = all_to_all_leading_back(y, Pn, e_local, axis_name)  # [E, C, H]
+    out = jnp.einsum("nec,ech->nh", disp, y) * g[:, None]
+    return out.reshape(b, T, H), aux
+
+
+def all_to_all_leading(x, Pn, e_local, axis_name):
+    """[E=P*El, C, H] -> [P, El, C, H] where output row p holds rank p's
+    capacity block for this rank's experts."""
+    x = x.reshape(Pn, e_local, *x.shape[1:])
+    return lax.all_to_all(x, axis_name, split_axis=0, concat_axis=0,
+                          tiled=False)
+
+
+def all_to_all_leading_back(y, Pn, e_local, axis_name):
+    """Inverse of :func:`all_to_all_leading`."""
+    y = lax.all_to_all(y, axis_name, split_axis=0, concat_axis=0,
+                       tiled=False)
+    return y.reshape(Pn * e_local, *y.shape[2:])
+
+
+def bert_moe_loss(moe_layers, shared, batch, cfg: BertConfig,
+                  mcfg: MoEConfig, axis_name: str = "expert"):
+    """Batch-sharded MLM+NSP+aux loss with expert-parallel MoE FFNs
+    (inside shard_map; ``moe_layers`` leaves are this rank's expert
+    shards, ``batch`` leaves this rank's batch shard)."""
+    import optax
+
+    ids = batch["input_ids"]
+    B, T = ids.shape
+    emb = shared["embeddings"]
+    positions = jnp.arange(T)[None, :]
+    x = (emb["word_embeddings"]["embedding"][ids]
+         + emb["position_embeddings"]["embedding"][positions]
+         + emb["token_type_embeddings"]["embedding"][batch["token_type_ids"]])
+    x = _layer_norm(emb["LayerNorm_0"], x, cfg.layer_norm_eps)
+
+    mask = batch["attention_mask"][:, None, None, :].astype(bool)
+    aux_total = jnp.float32(0.0)
+    for i in range(cfg.num_layers):
+        lp = moe_layers[f"layer_{i}"]
+        sh = shared["layers"][f"layer_{i}"]
+        y = _attention(sh["attention"], x, mask)
+        x = _layer_norm(sh["attention_ln"], x + y, cfg.layer_norm_eps)
+        h, aux = moe_ffn(lp, sh["gate"], x, mcfg, axis_name)
+        aux_total = aux_total + aux
+        x = _layer_norm(sh["output_ln"], x + h, cfg.layer_norm_eps)
+
+    pooled = jnp.tanh(_dense(shared["pooler"], x[:, 0]))
+    h = _dense(shared["mlm_dense"], x)
+    h = jax.nn.gelu(h, approximate=False)
+    h = _layer_norm(shared["mlm_ln"], h, cfg.layer_norm_eps)
+    table = emb["word_embeddings"]["embedding"]
+    mlm = (jnp.einsum("bth,vh->btv", h, table.astype(cfg.dtype))
+           + shared["mlm_bias"]).astype(jnp.float32)
+    nsp = _dense(shared["nsp"], pooled).astype(jnp.float32)
+
+    lmask = (batch["mlm_labels"] >= 0).astype(jnp.float32)
+    safe = jnp.maximum(batch["mlm_labels"], 0)
+    per_tok = optax.softmax_cross_entropy_with_integer_labels(mlm, safe)
+    num = lax.psum(jnp.sum(per_tok * lmask), axis_name)
+    den = lax.psum(jnp.sum(lmask), axis_name)
+    mlm_loss = num / jnp.maximum(den, 1.0)
+    nsp_ce = optax.softmax_cross_entropy_with_integer_labels(
+        nsp, batch["nsp_labels"])
+    nsp_loss = lax.pmean(nsp_ce.mean(), axis_name)
+    return mlm_loss + nsp_loss \
+        + mcfg.aux_weight * aux_total / cfg.num_layers
+
+
+def make_moe_mesh(num_shards: int, devices=None) -> Mesh:
+    import numpy as np
+    devices = list(devices if devices is not None else jax.devices())
+    if len(devices) < num_shards:
+        raise ValueError(f"expert parallelism needs {num_shards} devices, "
+                         f"have {len(devices)}")
+    return Mesh(np.asarray(devices[:num_shards]), ("expert",))
+
+
+def build_moe_loss(cfg: BertConfig, mcfg: MoEConfig, mesh: Mesh,
+                   axis_name: str = "expert"):
+    """jit ``(moe_stack, shared, batch) -> loss``: moe_stack sharded on
+    the leading expert dim, batch sharded on the leading batch dim,
+    shared replicated."""
+    def shard_fn(moe_layers, shared, batch):
+        return bert_moe_loss(moe_layers, shared, batch, cfg, mcfg,
+                             axis_name)
+
+    mapped = jax.shard_map(shard_fn, mesh=mesh,
+                           in_specs=(P(axis_name), P(), P(axis_name)),
+                           out_specs=P())
+    return jax.jit(mapped)
+
+
+def build_moe_train_step(cfg: BertConfig, mcfg: MoEConfig, mesh: Mesh,
+                         optimizer, axis_name: str = "expert"):
+    """jit ``((moe, shared), opt_state, batch) -> ((moe, shared),
+    opt_state, loss)``.
+
+    Expert shards train in place (each rank updates its own experts —
+    their gradients arrive naturally sharded from the all_to_all
+    transpose); shared params are replicated and their gradients are
+    identical across ranks (the loss psums make the loss invariant), so
+    one optimizer covers the whole tree."""
+    loss_fn = build_moe_loss(cfg, mcfg, mesh, axis_name)
+
+    @jax.jit
+    def step(params, opt_state, batch):
+        moe, shared = params
+        loss, grads = jax.value_and_grad(
+            lambda m, s: loss_fn(m, s, batch), argnums=(0, 1))(moe, shared)
+        updates, opt_state = optimizer.update(grads, opt_state,
+                                              (moe, shared))
+        params = jax.tree.map(jnp.add, (moe, shared), updates)
+        return params, opt_state, loss
+
+    return step

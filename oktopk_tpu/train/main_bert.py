@@ -51,6 +51,16 @@ def parse_args(argv=None):
                         "axis over a seq mesh with ring attention "
                         "(long-context extension; the reference has none, "
                         "SURVEY.md 5.7); 1 = off")
+    p.add_argument("--expert-shards", type=int, default=1,
+                   help="expert parallelism: Switch-style top-1 MoE FFNs "
+                        "sharded over an expert mesh, GShard all_to_all "
+                        "dispatch (extension; the reference has none, "
+                        "SURVEY.md 2.3); 1 = off")
+    p.add_argument("--num-experts", type=int, default=0,
+                   help="experts per MoE layer (default: = expert-shards)")
+    p.add_argument("--capacity-factor", type=float, default=1.25,
+                   help="MoE token capacity per expert, as a multiple of "
+                        "the even-routing share")
     p.add_argument("--data-dir", default="./data")
     p.add_argument("--seed", type=int, default=42)
     p.add_argument("--fake-devices", type=int, default=0)
@@ -89,6 +99,8 @@ def main(argv=None):
         return run_pipeline(args)
     if args.seq_shards > 1:
         return run_seq_parallel(args)
+    if args.expert_shards > 1:
+        return run_expert_parallel(args)
 
     num_workers = len(jax.devices())
     cfg = TrainConfig(
@@ -220,11 +232,43 @@ def run_pipeline(args):
     return 0
 
 
+def _pretrain_loop(args, logger, step_fn, params, opt_state, global_bs,
+                   checkpoint_payload):
+    """Shared dataset/loop/log/checkpoint tail of the whole-model parallel
+    paths (seq, expert): ``step_fn(params, opt_state, batch) -> (params,
+    opt_state, loss)``; ``checkpoint_payload(params) -> dict`` shapes what
+    rank 0 saves."""
+    import time
+
+    import jax
+
+    from oktopk_tpu.data import make_dataset
+
+    data_iter, meta = make_dataset("wikipedia", args.model, global_bs,
+                                   path=args.data_dir, seed=args.seed,
+                                   seq_len=args.max_seq_length)
+    if meta.get("synthetic"):
+        logger.warning("Wikipedia shards not found: synthetic MLM/NSP data")
+
+    t0 = time.time()
+    for i in range(args.num_minibatches):
+        params, opt_state, loss = step_fn(params, opt_state,
+                                          next(data_iter))
+        if (i + 1) % args.log_every == 0:
+            dt = (time.time() - t0) / args.log_every
+            logger.info("iter %d loss %.4f %.3fs/it", i + 1, float(loss),
+                        dt)
+            t0 = time.time()
+    if args.ckpt_dir and jax.process_index() == 0:
+        from oktopk_tpu.train.checkpoint import save_checkpoint
+        save_checkpoint(args.ckpt_dir, checkpoint_payload(params),
+                        args.num_minibatches)
+    return params
+
+
 def run_seq_parallel(args):
     """Sequence-parallel pretraining: token axis sharded over a seq mesh
     with ring attention (long-context path; see parallel/bert_seq.py)."""
-    import time
-
     import jax
 
     from oktopk_tpu.data import make_dataset
@@ -268,26 +312,74 @@ def run_seq_parallel(args):
                     t_total=args.num_minibatches)
     opt_state = opt.init(params)
     step = build_seq_train_step(cfg, mesh, opt)
+    _pretrain_loop(args, logger, step, params, opt_state, args.batch_size,
+                   lambda p: {"params": p, "model_state": {}})
+    return 0
 
-    data_iter, meta = make_dataset("wikipedia", args.model, args.batch_size,
-                                   path=args.data_dir, seed=args.seed,
-                                   seq_len=args.max_seq_length)
-    if meta.get("synthetic"):
-        logger.warning("Wikipedia shards not found: synthetic MLM/NSP data")
 
-    t0 = time.time()
-    for i in range(args.num_minibatches):
-        params, opt_state, loss = step(params, opt_state, next(data_iter))
-        if (i + 1) % args.log_every == 0:
-            dt = (time.time() - t0) / args.log_every
-            logger.info("iter %d loss %.4f %.3fs/it", i + 1, float(loss),
-                        dt)
-            t0 = time.time()
-    if args.ckpt_dir and jax.process_index() == 0:
-        from oktopk_tpu.train.checkpoint import save_checkpoint
-        save_checkpoint(args.ckpt_dir, {"params": params,
-                                        "model_state": {}},
-                        args.num_minibatches)
+def run_expert_parallel(args):
+    """Expert-parallel MoE pretraining: Switch-style top-1 MoE FFNs with
+    GShard all_to_all dispatch over an expert mesh; batch sharded on the
+    same axis (see parallel/bert_moe.py)."""
+    import jax
+    import jax.numpy as jnp
+
+    from oktopk_tpu.models.bert import BertConfig, BertForPreTraining
+    from oktopk_tpu.optim import bert_adam
+    from oktopk_tpu.parallel.bert_moe import (MoEConfig,
+                                              build_moe_train_step,
+                                              experts_from_dense,
+                                              make_moe_mesh)
+    from oktopk_tpu.utils.logging import get_logger
+
+    logger = get_logger("oktopk_tpu.bert")
+    E = args.num_experts or args.expert_shards
+    if E % args.expert_shards:
+        raise SystemExit("--num-experts must divide by --expert-shards")
+    if args.batch_size % args.expert_shards:
+        raise SystemExit("--batch-size must divide by --expert-shards")
+    if args.compressor != "dense":
+        raise SystemExit(
+            "--expert-shards trains with dense gradients (expert shards "
+            "already minimise comm via top-1 dispatch; composing the "
+            "sparse collectives needs a data axis) — pass "
+            "--compressor dense")
+    if args.gradient_accumulation_steps != 1:
+        raise SystemExit("--gradient-accumulation-steps is not wired into "
+                         "the expert-parallel path yet")
+    dtype = jnp.dtype(args.compute_dtype)
+    cfg = {"bert_base": BertConfig.base, "bert_large": BertConfig.large,
+           "bert_tiny": BertConfig.tiny}[args.model](dtype=dtype)
+    mcfg = MoEConfig(num_experts=E,
+                     capacity_factor=args.capacity_factor)
+    mesh = make_moe_mesh(args.expert_shards)
+    logger.info("expert-parallel MoE BERT: %s, %d experts over %d shards "
+                "(cap factor %.2f)", args.model, E, args.expert_shards,
+                args.capacity_factor)
+
+    ex = jnp.zeros((2, args.max_seq_length), jnp.int32)
+    rng = jax.random.PRNGKey(args.seed)
+    dense_params = BertForPreTraining(cfg).init(
+        {"params": rng, "dropout": rng}, ex, ex, jnp.ones_like(ex),
+        train=False)["params"]
+    # gate_scale > 0: a zero router ties every token to expert 0 and the
+    # capacity bound then drops most of the batch (bert_moe.py docstring)
+    params = experts_from_dense(dense_params, E, gate_scale=0.02,
+                                seed=args.seed)
+    opt = bert_adam(lr=args.lr, warmup=args.warmup_proportion,
+                    t_total=args.num_minibatches)
+    opt_state = opt.init(params)
+    step = build_moe_train_step(cfg, mcfg, mesh, opt)
+    # --batch-size is per-worker (as in the DP/pipeline paths); the MoE
+    # batch is sharded over the expert axis, so request the global batch
+    global_bs = args.batch_size * args.expert_shards
+    # MoE params cannot collapse to the single-module layout once the
+    # experts diverge — save them under a distinct key so nothing mistakes
+    # the tuple for BertForPreTraining params
+    _pretrain_loop(args, logger, step, params, opt_state, global_bs,
+                   lambda p: {"moe_params": {"layers": p[0],
+                                             "shared": p[1]},
+                              "model_state": {}})
     return 0
 
 
