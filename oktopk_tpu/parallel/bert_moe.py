@@ -180,11 +180,18 @@ def all_to_all_leading_back(y, Pn, e_local, axis_name):
 
 
 def bert_moe_loss(moe_layers, shared, batch, cfg: BertConfig,
-                  mcfg: MoEConfig, axis_name: str = "expert"):
+                  mcfg: MoEConfig, axis_name: str = "expert",
+                  data_axis=None):
     """Batch-sharded MLM+NSP+aux loss with expert-parallel MoE FFNs
     (inside shard_map; ``moe_layers`` leaves are this rank's expert
-    shards, ``batch`` leaves this rank's batch shard)."""
+    shards, ``batch`` leaves this rank's batch shard). With ``data_axis``
+    (the composed data x expert mesh) experts are replicated over data —
+    their gradients psum across it in the shard_map transpose — and the
+    loss reductions span both axes; the dispatch all_to_all stays within
+    each data row's expert group."""
     import optax
+
+    axes = (axis_name,) if data_axis is None else (data_axis, axis_name)
 
     ids = batch["input_ids"]
     B, T = ids.shape
@@ -203,6 +210,8 @@ def bert_moe_loss(moe_layers, shared, batch, cfg: BertConfig,
         y = _attention(sh["attention"], x, mask)
         x = _layer_norm(sh["attention_ln"], x + y, cfg.layer_norm_eps)
         h, aux = moe_ffn(lp, sh["gate"], x, mcfg, axis_name)
+        if data_axis is not None:
+            aux = lax.pmean(aux, data_axis)   # f/p stats global over data
         aux_total = aux_total + aux
         x = _layer_norm(sh["output_ln"], x + h, cfg.layer_norm_eps)
 
@@ -218,36 +227,48 @@ def bert_moe_loss(moe_layers, shared, batch, cfg: BertConfig,
     lmask = (batch["mlm_labels"] >= 0).astype(jnp.float32)
     safe = jnp.maximum(batch["mlm_labels"], 0)
     per_tok = optax.softmax_cross_entropy_with_integer_labels(mlm, safe)
-    num = lax.psum(jnp.sum(per_tok * lmask), axis_name)
-    den = lax.psum(jnp.sum(lmask), axis_name)
+    num = lax.psum(jnp.sum(per_tok * lmask), axes)
+    den = lax.psum(jnp.sum(lmask), axes)
     mlm_loss = num / jnp.maximum(den, 1.0)
     nsp_ce = optax.softmax_cross_entropy_with_integer_labels(
         nsp, batch["nsp_labels"])
-    nsp_loss = lax.pmean(nsp_ce.mean(), axis_name)
+    nsp_loss = lax.pmean(nsp_ce.mean(), axes)
     return mlm_loss + nsp_loss \
         + mcfg.aux_weight * aux_total / cfg.num_layers
 
 
-def make_moe_mesh(num_shards: int, devices=None) -> Mesh:
+def make_moe_mesh(num_shards: int, devices=None, data_size: int = 1) -> Mesh:
+    """1-D ("expert",) mesh, or 2-D ("data", "expert") when
+    ``data_size > 1`` (experts replicated over data)."""
     import numpy as np
     devices = list(devices if devices is not None else jax.devices())
-    if len(devices) < num_shards:
-        raise ValueError(f"expert parallelism needs {num_shards} devices, "
+    need = num_shards * data_size
+    if len(devices) < need:
+        raise ValueError(f"expert parallelism needs {need} devices, "
                          f"have {len(devices)}")
+    if data_size > 1:
+        return Mesh(np.asarray(devices[:need]).reshape(data_size,
+                                                       num_shards),
+                    ("data", "expert"))
     return Mesh(np.asarray(devices[:num_shards]), ("expert",))
 
 
 def build_moe_loss(cfg: BertConfig, mcfg: MoEConfig, mesh: Mesh,
                    axis_name: str = "expert"):
     """jit ``(moe_stack, shared, batch) -> loss``: moe_stack sharded on
-    the leading expert dim, batch sharded on the leading batch dim,
+    the leading expert dim (replicated over data when the mesh has that
+    axis), batch sharded on the leading batch dim over data x expert,
     shared replicated."""
+    data_axis = "data" if "data" in mesh.axis_names else None
+    batch_spec = P(axis_name) if data_axis is None \
+        else P((data_axis, axis_name))
+
     def shard_fn(moe_layers, shared, batch):
         return bert_moe_loss(moe_layers, shared, batch, cfg, mcfg,
-                             axis_name)
+                             axis_name, data_axis=data_axis)
 
     mapped = jax.shard_map(shard_fn, mesh=mesh,
-                           in_specs=(P(axis_name), P(), P(axis_name)),
+                           in_specs=(P(axis_name), P(), batch_spec),
                            out_specs=P())
     return jax.jit(mapped)
 

@@ -111,6 +111,21 @@ class TestBertExpertParallel:
             losses_got[pshards] = float(loss_fn(moe, shared, batch))
         np.testing.assert_allclose(losses_got[4], losses_got[1], rtol=1e-5)
 
+    def test_composed_data_x_expert_matches_ep1(self, cfg, params):
+        """dp=2 x ep=4 (batch over both axes, experts replicated over
+        data, dispatch within each data row) == all-local single device."""
+        moe, shared = experts_from_dense(params, E, gate_scale=0.5, seed=9)
+        moe = perturb(moe)
+        mcfg = MoEConfig(num_experts=E, capacity_factor=float(E))
+        batch = make_batch(np.random.RandomState(7), cfg.vocab_size)
+        ref_fn = build_moe_loss(cfg, mcfg, make_moe_mesh(1))
+        want = float(ref_fn(moe, shared, batch))
+        mesh = make_moe_mesh(4, data_size=2)
+        assert mesh.axis_names == ("data", "expert")
+        got = float(build_moe_loss(cfg, mcfg, mesh)(moe, shared, batch))
+        # psum reduction order differs across mesh layouts
+        np.testing.assert_allclose(got, want, rtol=5e-5)
+
     def test_gradients_flow_to_experts_and_gate(self, cfg, params):
         moe, shared = experts_from_dense(params, E)
         moe = perturb(moe)
