@@ -1,0 +1,90 @@
+"""Long-context memory scaling evidence: per-chip activation memory vs
+sequence shards.
+
+The sequence-parallel claim (parallel/bert_seq.py, ring attention) is that
+per-chip activation memory scales as T/P — no [T, T] score matrix is ever
+materialised and every positionwise tensor is sharded on the token axis.
+XLA's compiled memory analysis proves it without hardware: compile the
+seq-parallel BERT *training* program (loss + grads) at a fixed global
+sequence length for sp in {1, 2, 4, 8} and read the per-device temp
+allocation. The reference has no long-context axis at all (max_seq_length
+is a plain flag, SURVEY.md §5.7) — its activation memory per GPU is fixed
+at the sp=1 column.
+
+Writes logs/memory_scaling.json and prints one MEMSCALE JSON line.
+Usage: python scripts/memory_scaling.py [--seq-len 512] [--batch 2]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--seq-len", type=int, default=512)
+    ap.add_argument("--batch", type=int, default=2)
+    ap.add_argument("--shards", default="1,2,4,8")
+    ap.add_argument("--out", default="logs/memory_scaling.json")
+    args = ap.parse_args()
+
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                               + " --xla_force_host_platform_device_count=8")
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+
+    from oktopk_tpu.models.bert import BertConfig, BertForPreTraining
+    from oktopk_tpu.parallel.bert_seq import build_seq_loss, make_seq_mesh
+
+    T, B = args.seq_len, args.batch
+    cfg = BertConfig.tiny()
+    if cfg.max_position < T:
+        import dataclasses
+        cfg = dataclasses.replace(cfg, max_position=T)
+
+    ex = jnp.zeros((2, T), jnp.int32)
+    rng = jax.random.PRNGKey(0)
+    params = BertForPreTraining(cfg).init(
+        {"params": rng, "dropout": rng}, ex, ex, jnp.ones_like(ex),
+        train=False)["params"]
+    batch = {
+        "input_ids": jnp.zeros((B, T), jnp.int32),
+        "token_type_ids": jnp.zeros((B, T), jnp.int32),
+        "attention_mask": jnp.ones((B, T), jnp.int32),
+        "mlm_labels": jnp.zeros((B, T), jnp.int32),
+        "nsp_labels": jnp.zeros((B,), jnp.int32),
+    }
+
+    rows = []
+    for sp in [int(s) for s in args.shards.split(",")]:
+        mesh = make_seq_mesh(sp)
+        loss_fn = build_seq_loss(cfg, mesh)
+        grad_fn = jax.jit(jax.grad(loss_fn))
+        stats = grad_fn.lower(params, batch).compile().memory_analysis()
+        rows.append({
+            "seq_shards": sp,
+            "tokens_per_chip": T // sp,
+            "temp_bytes_per_chip": int(stats.temp_size_in_bytes),
+            "arg_bytes": int(stats.argument_size_in_bytes),
+        })
+        print(f"[memscale] sp={sp}: T/chip={T // sp} "
+              f"temp={stats.temp_size_in_bytes / 1e6:.2f} MB",
+              file=sys.stderr)
+
+    out = {"model": "bert_tiny", "seq_len": T, "batch": B, "rows": rows}
+    out_dir = os.path.dirname(args.out)
+    if out_dir:
+        os.makedirs(out_dir, exist_ok=True)
+    with open(args.out, "w") as f:
+        json.dump(out, f, indent=1)
+    print("MEMSCALE " + json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()
