@@ -176,6 +176,89 @@ def build_seq_train_step(cfg: BertConfig, mesh: Mesh, optimizer,
     return step
 
 
+def _batch_specs(data_axis, seq_axis):
+    """PartitionSpecs for a pretraining batch on a (data,) x seq mesh:
+    [B, T] leaves split tokens over ``seq_axis`` (and batch over
+    ``data_axis`` when present); ``nsp_labels`` follows the batch dim."""
+    tok_spec = P(data_axis, seq_axis)
+    return {"input_ids": tok_spec, "token_type_ids": tok_spec,
+            "attention_mask": tok_spec, "mlm_labels": tok_spec,
+            "nsp_labels": P(data_axis)}
+
+
+def build_seq_sparse_train_step(cfg: BertConfig, mesh: Mesh, optimizer,
+                                algo_cfg, compressor: str = "oktopk",
+                                warmup: bool = True,
+                                axis_name: str = "seq",
+                                data_axis: str = "data"):
+    """Sparse data parallelism composed with sequence parallelism: jit
+    ``(params, sparse_state, opt_state, batch) -> (params, sparse_state,
+    opt_state, loss)`` on a (data, seq) mesh.
+
+    Each data row computes its own gradient through the ring-attention
+    loss (psums over ``seq`` only — ``data_axis=None`` in the loss keeps
+    rows independent), the flat gradient goes through the selected sparse
+    collective over ``data`` (the reference's whole framework, now riding
+    under long context it never had), and each row applies the identical
+    reduced gradient.
+
+    Replica model: params / opt_state / sparse_state all carry a leading
+    ``[dp]`` axis sharded over ``data`` — each data rank holds its own
+    replica, exactly like the reference's MPI DP ranks, and the rows stay
+    bitwise identical by construction (same reduced gradient, same
+    update). This is also what VMA tracking can type: the collectives'
+    gathered outputs are "varying" (equal across ranks but not provably
+    so to the type system), and tracking must stay ON because the
+    ring-attention / loss-psum gradient transposes are only exact under
+    ``check_vma=True``. ``algo_cfg.num_workers`` must equal the data axis
+    size and ``algo_cfg.n`` the flat parameter count. Use
+    :func:`stack_replicas` to lift single-copy pytrees."""
+    from oktopk_tpu.collectives.registry import get_algorithm
+    from oktopk_tpu.ops.compaction import resolve_use_pallas
+
+    algo_cfg = resolve_use_pallas(algo_cfg, mesh)
+    algo = get_algorithm(compressor, warmup=warmup)
+    batch_specs = _batch_specs(data_axis, axis_name)
+
+    def shard_fn(params, sstate, opt_state, batch):
+        row = lambda t: jax.tree.map(lambda x: x[0], t)
+        unrow = lambda t: jax.tree.map(lambda x: x[None], t)
+        params, sp, opt_state = row(params), row(sstate), row(opt_state)
+        loss, grads = jax.value_and_grad(
+            lambda p: bert_seq_loss(p, batch, cfg, axis_name,
+                                    data_axis=None))(params)
+        leaves, treedef = jax.tree.flatten(grads)
+        flat = jnp.concatenate([x.reshape(-1) for x in leaves])
+        assert flat.size == algo_cfg.n, (flat.size, algo_cfg.n)
+        reduced, sp = algo(flat, sp, algo_cfg, data_axis)
+        off, results = 0, []
+        for x in leaves:
+            results.append(reduced[off:off + x.size].reshape(x.shape))
+            off += x.size
+        grads = jax.tree.unflatten(treedef, results)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = jax.tree.map(jnp.add, params, updates)
+        # loss is already seq-invariant (the loss psums), so only the
+        # data-mean remains
+        return (unrow(params), unrow(sp), unrow(opt_state),
+                lax.pmean(loss, data_axis))
+
+    spec_d = P(data_axis)
+    mapped = jax.shard_map(
+        shard_fn, mesh=mesh,
+        in_specs=(spec_d, spec_d, spec_d, batch_specs),
+        out_specs=(spec_d, spec_d, spec_d, P()),
+        check_vma=True)
+    return jax.jit(mapped, donate_argnums=(0, 1, 2))
+
+
+def stack_replicas(tree, dp: int):
+    """Lift a single-copy pytree to the per-data-rank replica layout
+    (leading [dp] axis) used by :func:`build_seq_sparse_train_step`."""
+    return jax.tree.map(
+        lambda x: jnp.broadcast_to(x, (dp,) + x.shape), tree)
+
+
 def build_seq_loss(cfg: BertConfig, mesh: Mesh,
                    axis_name: str = "seq"):
     """jit ``(params, batch) -> loss`` with batch token dims sharded over
@@ -183,10 +266,7 @@ def build_seq_loss(cfg: BertConfig, mesh: Mesh,
     the composed dp x sp form). ``nsp_labels`` follows the batch dim;
     everything else [B, T] splits on the token axis."""
     data_axis = "data" if "data" in mesh.axis_names else None
-    tok_spec = P(data_axis, axis_name)
-    batch_specs = {"input_ids": tok_spec, "token_type_ids": tok_spec,
-                   "attention_mask": tok_spec, "mlm_labels": tok_spec,
-                   "nsp_labels": P(data_axis)}
+    batch_specs = _batch_specs(data_axis, axis_name)
 
     def shard_fn(params, batch):
         return bert_seq_loss(params, batch, cfg, axis_name,

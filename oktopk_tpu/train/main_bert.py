@@ -51,6 +51,11 @@ def parse_args(argv=None):
                         "axis over a seq mesh with ring attention "
                         "(long-context extension; the reference has none, "
                         "SURVEY.md 5.7); 1 = off")
+    p.add_argument("--seq-data-shards", type=int, default=1,
+                   help="data axis of the composed data x seq mesh: "
+                        "sparse-allreduce DP (any --compressor) riding "
+                        "under sequence parallelism; 1 = pure seq mesh "
+                        "(dense only)")
     p.add_argument("--expert-shards", type=int, default=1,
                    help="expert parallelism: Switch-style top-1 MoE FFNs "
                         "sharded over an expert mesh, GShard all_to_all "
@@ -99,6 +104,10 @@ def main(argv=None):
         return run_pipeline(args)
     if args.seq_shards > 1:
         return run_seq_parallel(args)
+    if args.seq_data_shards > 1:
+        raise SystemExit("--seq-data-shards composes with sequence "
+                         "parallelism — it needs --seq-shards > 1 "
+                         "(plain sparse DP is the default path)")
     if args.expert_shards > 1:
         return run_expert_parallel(args)
 
@@ -114,13 +123,7 @@ def main(argv=None):
     logger.info("BERT pretrain: %s on %d devices, compressor=%s density=%g",
                 args.model, num_workers, args.compressor, args.density)
 
-    # BERT disables dense warmup (reference BERT/bert/allreducer.py:355) and
-    # retunes cadences/scales (:359-361, :188-190)
-    algo_cfg = OkTopkConfig(
-        warmup_steps=0, local_recompute_every=128,
-        global_recompute_every=128, repartition_every=64,
-        local_adapt_scale=1.025, global_adapt_scale=1.036,
-        wire_dtype=args.wire_dtype)
+    algo_cfg = _bert_algo_cfg(args)
 
     trainer = Trainer(cfg, algo_cfg=algo_cfg)
     preempt = None
@@ -232,6 +235,18 @@ def run_pipeline(args):
     return 0
 
 
+def _bert_algo_cfg(args, **kw):
+    """The BERT sparse-allreduce tuning: dense warmup disabled (reference
+    BERT/bert/allreducer.py:355), retuned cadences/scales (:359-361,
+    :188-190). One definition for every BERT path."""
+    from oktopk_tpu.config import OkTopkConfig
+    return OkTopkConfig(
+        warmup_steps=0, local_recompute_every=128,
+        global_recompute_every=128, repartition_every=64,
+        local_adapt_scale=1.025, global_adapt_scale=1.036,
+        wire_dtype=args.wire_dtype, **kw)
+
+
 def _pretrain_loop(args, logger, step_fn, params, opt_state, global_bs,
                    checkpoint_payload):
     """Shared dataset/loop/log/checkpoint tail of the whole-model parallel
@@ -280,13 +295,14 @@ def run_seq_parallel(args):
     import jax.numpy as jnp
 
     logger = get_logger("oktopk_tpu.bert")
+    dp = args.seq_data_shards
     if args.max_seq_length % args.seq_shards:
         raise SystemExit("--max-seq-length must divide by --seq-shards")
-    if args.compressor != "dense":
+    if args.compressor != "dense" and dp <= 1:
         raise SystemExit(
-            "--seq-shards trains with dense gradients (sequence shards "
-            "see the full replicated parameter set; composing the sparse "
-            "collectives needs a data axis) — pass --compressor dense")
+            "sparse collectives over a pure seq mesh have no data axis to "
+            "reduce over — add --seq-data-shards N for the composed "
+            "data x seq mesh, or pass --compressor dense")
     if args.gradient_accumulation_steps != 1:
         raise SystemExit("--gradient-accumulation-steps is not wired into "
                          "the seq-parallel path yet")
@@ -298,10 +314,12 @@ def run_seq_parallel(args):
         # long-context runs need position rows for every global position —
         # the embedding gather clamps silently under jit otherwise
         cfg = dataclasses.replace(cfg, max_position=args.max_seq_length)
-    mesh = make_seq_mesh(args.seq_shards)
+    mesh = make_seq_mesh(args.seq_shards, data_size=dp)
     logger.info("seq-parallel BERT: %s, T=%d over %d shards "
-                "(T/P=%d per chip)", args.model, args.max_seq_length,
-                args.seq_shards, args.max_seq_length // args.seq_shards)
+                "(T/P=%d per chip)%s", args.model, args.max_seq_length,
+                args.seq_shards, args.max_seq_length // args.seq_shards,
+                f", data axis dp={dp} compressor={args.compressor}"
+                if dp > 1 else "")
 
     ex = jnp.zeros((2, args.max_seq_length), jnp.int32)
     rng = jax.random.PRNGKey(args.seed)
@@ -310,9 +328,42 @@ def run_seq_parallel(args):
         train=False)["params"]
     opt = bert_adam(lr=args.lr, warmup=args.warmup_proportion,
                     t_total=args.num_minibatches)
+
+    if dp > 1 and args.compressor != "dense":
+        # composed sparse DP x seq: per-data-rank replica layout
+        from oktopk_tpu.collectives.state import init_state
+        from oktopk_tpu.config import OkTopkConfig
+        from oktopk_tpu.parallel.bert_seq import (
+            build_seq_sparse_train_step, stack_replicas)
+
+        n = sum(x.size for x in jax.tree.leaves(params))
+        acfg = _bert_algo_cfg(args, n=n, num_workers=dp,
+                              density=args.density)
+        sstep = build_seq_sparse_train_step(cfg, mesh, opt, acfg,
+                                            compressor=args.compressor,
+                                            warmup=False)
+        carry = (stack_replicas(params, dp),
+                 stack_replicas(init_state(acfg), dp))
+        opt_state = stack_replicas(opt.init(params), dp)
+
+        def step(ps, opt_state, batch):
+            p, ss = ps
+            p, ss, opt_state, loss = sstep(p, ss, opt_state, batch)
+            return (p, ss), opt_state, loss
+
+        _pretrain_loop(
+            args, logger, step, carry, opt_state,
+            # --batch-size is per data rank, as on every other path
+            args.batch_size * dp,
+            # row 0 of the replicas IS the single-module layout
+            lambda ps: {"params": jax.tree.map(lambda x: x[0], ps[0]),
+                        "model_state": {}})
+        return 0
+
     opt_state = opt.init(params)
     step = build_seq_train_step(cfg, mesh, opt)
-    _pretrain_loop(args, logger, step, params, opt_state, args.batch_size,
+    _pretrain_loop(args, logger, step, params, opt_state,
+                   args.batch_size * dp,
                    lambda p: {"params": p, "model_state": {}})
     return 0
 
