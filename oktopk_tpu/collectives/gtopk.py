@@ -21,7 +21,6 @@ from __future__ import annotations
 import jax.numpy as jnp
 
 from oktopk_tpu.collectives.state import SparseState, bump
-from oktopk_tpu.comm import psum
 from oktopk_tpu.comm.primitives import ppermute_pair
 from oktopk_tpu.config import OkTopkConfig
 from oktopk_tpu.ops import exact_topk, scatter_sparse

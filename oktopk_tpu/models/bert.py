@@ -15,7 +15,7 @@ bfloat16; shapes are static (fixed seq len, the reference uses 128).
 from __future__ import annotations
 
 import dataclasses
-from typing import Any, Optional
+from typing import Any
 
 import flax.linen as nn
 import jax.numpy as jnp

@@ -3,7 +3,7 @@ with BN + single 512->num_classes classifier head, CIFAR-sized)."""
 
 from __future__ import annotations
 
-from typing import Any, Optional, Sequence, Union
+from typing import Any, Optional
 
 import flax.linen as nn
 import jax.numpy as jnp

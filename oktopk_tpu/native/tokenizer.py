@@ -6,7 +6,6 @@ points the pipelines use (``encode`` and ``encode_pair``)."""
 from __future__ import annotations
 
 import ctypes
-import os
 from typing import List, Optional, Tuple
 
 import numpy as np

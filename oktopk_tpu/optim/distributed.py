@@ -19,7 +19,6 @@ single allreduce.
 
 from __future__ import annotations
 
-from functools import partial
 from typing import Any, Callable, Optional
 
 import flax.struct

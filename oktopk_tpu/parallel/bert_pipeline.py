@@ -23,7 +23,6 @@ functions only need the ``data`` axis in scope) and is exposed via
 
 from __future__ import annotations
 
-from functools import partial
 from typing import Optional
 
 import jax

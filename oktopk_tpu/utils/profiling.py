@@ -30,7 +30,7 @@ import os
 import time
 from collections import defaultdict
 from contextlib import contextmanager
-from typing import Dict, Iterable, Optional
+from typing import Dict, Optional
 
 
 class PhaseTimers:

@@ -28,7 +28,6 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 def run_one(model: str, compressor: str, steps: int, mesh, density: float,
             lr: float, out_dir: str, log_every: int = 10,
             batch_size: int = 8):
-    import numpy as np
 
     from oktopk_tpu.config import TrainConfig
     from oktopk_tpu.data.synthetic import teacher_iterator
