@@ -1,0 +1,106 @@
+"""Cross-algorithm comparison sweep: every sparse allreduce on one tensor.
+
+The reference's de-facto ablation rig is its sbatch suites running all
+algorithms on the same model/data (VGG/sbatch_vgg_jobs.sh:1-7) and reading
+volumes/EPS out of logs. TPU-native form: the 8-worker virtual mesh, one
+correlated gradient stream, every registry algorithm — steady-state mean
+comm volume (elements and wire bytes), mean EPS vs the dense mean, and the
+cumulative-EPS trend that shows error feedback draining (the
+PROFILING_NORM standard, reference VGG/allreducer.py:1072-1080).
+
+Writes logs/algo_sweep.json and prints one SWEEP JSON line.
+Usage: python scripts/algo_sweep.py [--n 262144] [--density 0.01]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+ALGOS = ["dense", "topkA", "topkA2", "topkAopt", "gtopk", "gaussiank",
+         "gaussiankconcat", "gaussiankSA", "topkSA", "oktopk"]
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--n", type=int, default=1 << 18)
+    ap.add_argument("--density", type=float, default=0.01)
+    ap.add_argument("--steps", type=int, default=12)
+    ap.add_argument("--out", default="logs/algo_sweep.json")
+    args = ap.parse_args()
+
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                               + " --xla_force_host_platform_device_count=8")
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+    import numpy as np
+
+    from oktopk_tpu.collectives.api import (batched_init_state,
+                                            build_allreduce_step,
+                                            eps_vs_dense)
+    from oktopk_tpu.comm.mesh import get_mesh
+    from oktopk_tpu.config import OkTopkConfig
+
+    P = 8
+    mesh = get_mesh((P,), ("data",))
+    rng = np.random.RandomState(0)
+    base = rng.randn(P, args.n).astype(np.float32)
+    # one shared gradient stream so algorithms are strictly comparable
+    streams = [jnp.asarray(base + 0.3 * rng.randn(P, args.n)
+                           .astype(np.float32))
+               for _ in range(args.steps)]
+    dense_means = [np.asarray(jnp.mean(g, 0)) for g in streams]
+
+    rows = []
+    for algo in ALGOS:
+        cfg = OkTopkConfig(n=args.n, num_workers=P, density=args.density,
+                           warmup_steps=0, local_recompute_every=4,
+                           global_recompute_every=4)
+        step = build_allreduce_step(algo, cfg, mesh, warmup=False)
+        state = batched_init_state(cfg)
+        vols, epss = [], []
+        cum = np.zeros(args.n)
+        cum_target = np.zeros(args.n)
+        for i, g in enumerate(streams):
+            out, state = step(g, state)
+            vols.append(float(state.last_volume[0]))
+            epss.append(float(eps_vs_dense(jnp.asarray(dense_means[i]),
+                                           out[0])))
+            cum += np.asarray(out[0])
+            cum_target += dense_means[i]
+        cum_eps = float(np.linalg.norm(cum_target - cum)
+                        / (np.linalg.norm(cum_target) + 1e-12))
+        mean_vol = sum(vols) / len(vols)
+        # dense moves raw f32 values with no indices (bench.py convention);
+        # sparse volumes count (index, value) pairs at the wire format
+        mean_bytes = (mean_vol * 4.0 if algo == "dense"
+                      else mean_vol / 2.0 * cfg.wire_pair_bytes)
+        rows.append({
+            "algo": algo,
+            "mean_volume_elems": round(mean_vol, 1),
+            "mean_volume_bytes": round(mean_bytes, 1),
+            "mean_eps_vs_dense": round(sum(epss) / len(epss), 4),
+            "cumulative_eps": round(cum_eps, 4),
+        })
+        print(f"[sweep] {algo:16s} vol {mean_vol:10.0f} elems  "
+              f"eps {rows[-1]['mean_eps_vs_dense']:.3f}  "
+              f"cum_eps {cum_eps:.3f}", file=sys.stderr)
+
+    out = {"n": args.n, "workers": P, "density": args.density,
+           "steps": args.steps, "k": cfg.k,
+           "wire_dtype": cfg.wire_dtype, "rows": rows}
+    out_dir = os.path.dirname(args.out)
+    if out_dir:
+        os.makedirs(out_dir, exist_ok=True)
+    with open(args.out, "w") as f:
+        json.dump(out, f, indent=1)
+    print("SWEEP " + json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()
