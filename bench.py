@@ -120,18 +120,44 @@ def step_time_probe(iters=10):
                               ("oktopk", 1, "float32"),
                               ("oktopk_b4", 4, "float32"),
                               ("dense_bf16", 1, "bfloat16")):
-        try:
-            cfg = TrainConfig(dnn="vgg16", dataset="cifar10", batch_size=16,
-                              lr=0.1, compressor=comp.split("_")[0],
-                              density=0.02, num_workers=1,
-                              num_buckets=buckets, compute_dtype=dt)
-            trainer = Trainer(cfg, mesh=mesh, warmup=False)
-            _ = _time_steps(trainer, batch, 2)        # compile + warm
-            times = _time_steps(trainer, batch, iters)
-        except Exception as e:
+        times = None
+        # the Pallas selection kernel is auto-enabled on TPU meshes; if its
+        # Mosaic compile fails on this chip generation, fall back to the
+        # portable selection path so the record still carries an oktopk
+        # step time (flagged via oktopk_pallas_failed)
+        for use_pallas in (None, False):
+            try:
+                cfg = TrainConfig(dnn="vgg16", dataset="cifar10",
+                                  batch_size=16,
+                                  lr=0.1, compressor=comp.split("_")[0],
+                                  density=0.02, num_workers=1,
+                                  num_buckets=buckets, compute_dtype=dt)
+                from oktopk_tpu.config import OkTopkConfig
+                acfg = OkTopkConfig(use_pallas=use_pallas)
+                trainer = Trainer(cfg, mesh=mesh, warmup=False,
+                                  algo_cfg=acfg)
+                _ = _time_steps(trainer, batch, 2)    # compile + warm
+                times = _time_steps(trainer, batch, iters)
+                break
+            except Exception as e:
+                print(f"[bench] {comp} probe "
+                      f"(use_pallas={use_pallas}) failed: {e!r}",
+                      file=sys.stderr)
+                # only a kernel-compile failure justifies switching the
+                # headline number to the portable selection path — a
+                # transient tunnel error must not be misattributed
+                looks_compile = any(t in repr(e) for t in
+                                    ("Mosaic", "mosaic", "Pallas",
+                                     "NotImplemented", "lowering"))
+                if (not comp.startswith("oktopk") or use_pallas is False
+                        or not looks_compile):
+                    break
+                out[f"{comp}_pallas_failed"] = True
+        if times is None:
             # a config that fails to compile/run must not take down the
-            # others' numbers (first contact already succeeded by here)
-            print(f"[bench] {comp} probe failed: {e!r}", file=sys.stderr)
+            # others' numbers (first contact already succeeded by here);
+            # and without a fallback measurement the flag would imply one
+            out.pop(f"{comp}_pallas_failed", None)
             continue
         ms = [t * 1e3 for t in times]
         out[f"{comp}_ms"] = statistics.median(ms)
@@ -235,6 +261,7 @@ def main():
     for key in ("device", "oktopk_ms", "oktopk_ms_std", "dense_ms",
                 "dense_ms_std", "oktopk_b4_ms", "oktopk_b4_ms_std",
                 "dense_bf16_ms", "dense_bf16_ms_std",
+                "oktopk_pallas_failed", "oktopk_b4_pallas_failed",
                 "flops_per_step", "peak_flops_assumed",
                 "mfu_dense", "mfu_oktopk"):
         if key in steps:

@@ -63,12 +63,21 @@ def main():
                            global_recompute_every=4)
         step = build_allreduce_step(algo, cfg, mesh, warmup=False)
         state = batched_init_state(cfg)
-        vols, epss = [], []
+        vols, byts, epss = [], [], []
         cum = np.zeros(args.n)
         cum_target = np.zeros(args.n)
         for i, g in enumerate(streams):
             out, state = step(g, state)
-            vols.append(float(state.last_volume[0]))
+            v = float(state.last_volume[0])
+            # steady-state convention as bench.py's volume_probe: drop the
+            # exact-recompute steps (i % 4 == 0, incl. the cold step 0)
+            if i % cfg.local_recompute_every != 0:
+                vols.append(v)
+                # raw f32 values with no indices for dense AND for
+                # topkSA's dense-fallback steps (volume exactly >= 2n);
+                # (index, value) pairs at the wire format otherwise
+                byts.append(v * 4.0 if algo == "dense" or v >= 2.0 * args.n
+                            else v / 2.0 * cfg.wire_pair_bytes)
             epss.append(float(eps_vs_dense(jnp.asarray(dense_means[i]),
                                            out[0])))
             cum += np.asarray(out[0])
@@ -76,10 +85,7 @@ def main():
         cum_eps = float(np.linalg.norm(cum_target - cum)
                         / (np.linalg.norm(cum_target) + 1e-12))
         mean_vol = sum(vols) / len(vols)
-        # dense moves raw f32 values with no indices (bench.py convention);
-        # sparse volumes count (index, value) pairs at the wire format
-        mean_bytes = (mean_vol * 4.0 if algo == "dense"
-                      else mean_vol / 2.0 * cfg.wire_pair_bytes)
+        mean_bytes = sum(byts) / len(byts)
         rows.append({
             "algo": algo,
             "mean_volume_elems": round(mean_vol, 1),
