@@ -13,7 +13,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from oktopk_tpu.collectives.state import SparseState, bump
-from oktopk_tpu.comm.primitives import pvary_tree
+from oktopk_tpu.comm.primitives import pvary_like
 from oktopk_tpu.config import OkTopkConfig
 
 
@@ -21,9 +21,9 @@ def dense_allreduce(grad: jnp.ndarray, state: SparseState, cfg: OkTopkConfig,
                     axis_name: str = "data"):
     """psum-mean over the data axis (ring allreduce moves ~2n per worker)."""
     out = lax.pmean(grad, axis_name)
-    out, state = pvary_tree(
+    out, state = pvary_like(
         (out, bump(state, volume=2.0 * cfg.n,
-                   local_count=cfg.n, global_count=cfg.n)), axis_name)
+                   local_count=cfg.n, global_count=cfg.n)), grad)
     return out, state
 
 

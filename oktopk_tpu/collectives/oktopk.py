@@ -35,7 +35,7 @@ from jax import lax
 
 from oktopk_tpu.collectives.state import SparseState, bump
 from oktopk_tpu.comm import all_gather, all_to_all, axis_rank, psum
-from oktopk_tpu.comm.primitives import pvary_tree
+from oktopk_tpu.comm.primitives import pvary_like
 from oktopk_tpu.config import OkTopkConfig
 from oktopk_tpu.ops import (
     pack_by_region,
@@ -105,7 +105,7 @@ def _repartition(abs_acc, local_thresh, cfg: OkTopkConfig, axis_name: str):
         jnp.full((1,), n, jnp.int32)])
     # psum output is replication-invariant; the carried boundaries are
     # per-shard ("varying") under shard_map's VMA tracking — align them.
-    return pvary_tree(out, axis_name)
+    return pvary_like(out, abs_acc)
 
 
 def oktopk(grad: jnp.ndarray, state: SparseState, cfg: OkTopkConfig,
@@ -226,7 +226,7 @@ def oktopk(grad: jnp.ndarray, state: SparseState, cfg: OkTopkConfig,
         g_count = jnp.sum(keep)
         total_c = psum(cand_count, axis_name)
         vol = 2.0 * cand_count + 2.0 * (total_c - cand_count)
-        return pvary_tree((result, gt, g_count, vol), axis_name)
+        return pvary_like((result, gt, g_count, vol), acc)
 
     def predicted_branch():
         # Otherwise: threshold-select own region, fixed-capacity allgather,
@@ -254,7 +254,7 @@ def oktopk(grad: jnp.ndarray, state: SparseState, cfg: OkTopkConfig,
         gt_next = _newton_adapt(gt_use, total_g, totals[1].astype(jnp.int32),
                                 k, cfg, band_hi=cfg.band_hi_global)
         vol = 2.0 * gcount + 2.0 * (total_g - gcount)
-        return pvary_tree((result, gt_next, total_g, vol), axis_name)
+        return pvary_like((result, gt_next, total_g, vol), acc)
 
     result, gt_next, g_count, vol_b = lax.cond(
         recompute_global, exact_branch, predicted_branch)

@@ -21,7 +21,7 @@ from jax import lax
 
 from oktopk_tpu.collectives.state import SparseState, bump
 from oktopk_tpu.comm import all_gather, all_to_all, axis_rank, psum
-from oktopk_tpu.comm.primitives import pvary_tree
+from oktopk_tpu.comm.primitives import pvary_like
 from oktopk_tpu.config import OkTopkConfig
 from oktopk_tpu.ops import (
     gaussian_threshold,
@@ -69,17 +69,17 @@ def _split_allreduce(acc, lt, state: SparseState, cfg: OkTopkConfig,
         result = scatter_sparse(n, gv, gi)
         total = psum(gcount, axis_name)
         vol = 2.0 * gcount + 2.0 * (total - gcount)
-        return pvary_tree((result, vol, jnp.float32(1.0)), axis_name)
+        return pvary_like((result, vol, jnp.float32(1.0)), acc)
 
     def dense_gather():
         # Regions are disjoint, so psum of the partials is the dense gather
         # the reference falls back to (VGG/allreducer.py:1318-1351). The
         # psum is NOT wire-rounded, so the owner's gather-rounding
         # compensation must be off (third element 0.0).
-        return pvary_tree(
+        return pvary_like(
             (psum(reduced, axis_name), jnp.asarray(2.0 * n, jnp.float32),
              jnp.float32(0.0)),
-            axis_name)
+            acc)
 
     if dense_fallback:
         result, vol_b, gather_rounded = lax.cond(

@@ -72,22 +72,16 @@ def ppermute_shift(x, axis_name: str, shift: int = 1):
     return lax.ppermute(x, axis_name, perm)
 
 
-def pvary_tree(tree, axis_name: str):
-    """Mark every leaf as per-shard "varying" under shard_map's VMA tracking.
+def pvary_like(tree, ref):
+    """Mark every leaf varying over the axes ``ref`` is varying over.
 
-    ``lax.cond`` branches must agree on varying-ness; branches that mix
-    psum/constant (invariant) leaves with per-shard leaves use this to align
-    (see docs.jax.dev shard_map notebook, VMA section).
-    """
-    def _pvary(x):
-        x = jnp.asarray(x)
-        try:
-            already = axis_name in jax.typeof(x).vma
-        except Exception:
-            already = False
-        return x if already else lax.pcast(x, axis_name, to="varying")
-
-    return jax.tree.map(_pvary, tree)
+    The collectives use this to align ``lax.cond`` branch types with their
+    operands: a psum/iota-derived branch output is invariant (or varying
+    over the collective axis only), while carried state matches the
+    gradient's full vma — which under a composed mesh (data x pipe, data x
+    seq) spans MORE than the collective axis."""
+    vma = getattr(jax.typeof(jnp.asarray(ref)), "vma", frozenset())
+    return jax.tree.map(lambda x: pvary_to(jnp.asarray(x), vma), tree)
 
 
 def carry_vma(*arrays, axis_name):

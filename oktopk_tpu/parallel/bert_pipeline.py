@@ -9,11 +9,11 @@ is the ``lax.scan`` pipeline of parallel/pipeline.py over a 2-D
 - batch sharded over ``data``; transformer layers sharded over ``pipe``
   (models/bert_staged.py layout: stage_stack [S, ...], shared replicated);
 - each tick's activation hop is a ``ppermute`` along ``pipe``;
-- gradients: stage grads live on their pipe rank and are psum'd over
-  ``data`` (plain DP within a stage, the reference's stage DP groups);
-  shared (embeddings/heads) grads are psum'd over BOTH axes — embedding
-  cotangents materialise only on pipe rank 0 and head cotangents only on
-  the last rank, so the pipe-psum is a gather, not an overcount.
+- gradients: params are replicated over the axes they don't shard on, and
+  shard_map's VMA-aware AD transpose already completes their cotangents
+  over those axes (stage grads arrive data-complete, shared grads
+  data x pipe-complete) — no explicit grad psums (adding them overcounts
+  by the axis size; pinned by the sparse-composition oracle test).
 
 The optimizer step is dense-DP over stage-sharded flat vectors; composing
 the sparse collectives per stage group rides the same seams (the algorithm
@@ -34,6 +34,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 from oktopk_tpu.models.bert_staged import StagedBertPretrain
 from oktopk_tpu.parallel.pipeline import gpipe_apply
 from oktopk_tpu.train import losses
+from oktopk_tpu.utils.flatten import flatten_tree, unflatten_tree
 
 
 def _global_pretrain_loss(mlm, nsp, batch, data_axis):
@@ -42,17 +43,21 @@ def _global_pretrain_loss(mlm, nsp, batch, data_axis):
     A pmean of per-shard mean losses is NOT the global loss when shards
     carry different masked-token counts; sum numerators and denominators
     over the data axis instead (keeps pipeline loss bit-comparable to the
-    single-module oracle)."""
+    single-module oracle). ``data_axis=None`` keeps the loss LOCAL to this
+    data row (the sparse-DP composition needs independent per-row
+    gradients)."""
     import optax
+    psum = (lambda x: x) if data_axis is None \
+        else (lambda x: lax.psum(x, data_axis))
     mask = (batch["mlm_labels"] >= 0).astype(jnp.float32)
     safe = jnp.maximum(batch["mlm_labels"], 0)
     per_tok = optax.softmax_cross_entropy_with_integer_labels(mlm, safe)
-    mlm_num = lax.psum(jnp.sum(per_tok * mask), data_axis)
-    mlm_den = lax.psum(jnp.sum(mask), data_axis)
+    mlm_num = psum(jnp.sum(per_tok * mask))
+    mlm_den = psum(jnp.sum(mask))
     nsp_ce = optax.softmax_cross_entropy_with_integer_labels(
         nsp, batch["nsp_labels"])
-    nsp_num = lax.psum(jnp.sum(nsp_ce), data_axis)
-    nsp_den = lax.psum(jnp.asarray(nsp_ce.shape[0], jnp.float32), data_axis)
+    nsp_num = psum(jnp.sum(nsp_ce))
+    nsp_den = psum(jnp.asarray(nsp_ce.shape[0], jnp.float32))
     return mlm_num / jnp.maximum(mlm_den, 1.0) + nsp_num / nsp_den
 
 
@@ -161,14 +166,13 @@ def build_pipeline_train_step(staged: StagedBertPretrain, mesh: Mesh,
 
         loss, (g_stage, g_shared) = jax.value_and_grad(
             loss_fn, argnums=(0, 1))(my_stage, shared)
-        # the loss is already the GLOBAL weighted mean (psum of sums),
-        # so each shard's grads are partial contributions: psum over data
-        # completes them. Shared grads additionally psum over pipe
-        # (embedding cotangents exist only on pipe rank 0, head cotangents
-        # only on the last rank).
-        g_stage = jax.tree.map(lambda g: lax.psum(g, "data"), g_stage)
-        g_shared = jax.tree.map(
-            lambda g: lax.psum(lax.psum(g, "pipe"), "data"), g_shared)
+        # The loss is the GLOBAL weighted mean (psum of sums) and the
+        # params are replicated over the axes they don't shard on, so the
+        # shard_map AD transpose ALREADY completes their cotangents over
+        # those axes — g_stage arrives data-complete and g_shared
+        # (data x pipe)-complete. Explicit psums here would overcount by
+        # the axis size (caught by the sparse-composition oracle test:
+        # stage updates were 2x, shared 4x at dp=pp=2).
         if grad_clip is not None:
             flat = jnp.sqrt(sum(jnp.sum(g ** 2) for g in
                                 jax.tree.leaves((g_stage, g_shared))))
@@ -196,3 +200,149 @@ def build_pipeline_train_step(staged: StagedBertPretrain, mesh: Mesh,
         in_specs=(P("pipe"), P(), (P("pipe"), P()), batch_specs, P()),
         out_specs=(P("pipe"), P(), (P("pipe"), P()), P()))
     return jax.jit(mapped)
+
+
+def init_pipeline_sparse_states(stage_stack, shared, algo_cfg, dp: int):
+    """Per-(data rank, stage) sparse states for the composed step.
+
+    Returns ``(stage_sstate, shared_sstate)``: stage states stacked
+    [dp, S, ...] (sharded over data x pipe), shared state stacked
+    [dp, ...]. Requires uniform stage sizes (the staged split gives every
+    stage the same BertLayer block)."""
+    from oktopk_tpu.collectives.state import init_state
+
+    sizes = {int(sum(x[i].size for x in jax.tree.leaves(stage_stack)))
+             for i in range(jax.tree.leaves(stage_stack)[0].shape[0])}
+    assert len(sizes) == 1, f"non-uniform stage sizes {sizes}"
+    n_stage = sizes.pop()
+    n_shared = int(sum(x.size for x in jax.tree.leaves(shared)))
+    cfg_stage = algo_cfg.replace(n=n_stage, num_workers=dp)
+    cfg_shared = algo_cfg.replace(n=n_shared, num_workers=dp)
+    S = jax.tree.leaves(stage_stack)[0].shape[0]
+
+    def stack(s, lead):
+        return jax.tree.map(
+            lambda x: jnp.broadcast_to(x, lead + x.shape), s)
+
+    return (stack(init_state(cfg_stage), (dp, S)),
+            stack(init_state(cfg_shared), (dp,)))
+
+
+def build_pipeline_sparse_train_step(staged: StagedBertPretrain, mesh: Mesh,
+                                     num_microbatches: int, optimizer,
+                                     algo_cfg, compressor: str = "oktopk",
+                                     warmup: bool = True,
+                                     remat: bool = False):
+    """Sparse DP composed with the pipeline: jit ``((stage_stack, shared),
+    (stage_sstate, shared_sstate), opt_states, batch, rng) -> (...)`` on
+    the (data, pipe) mesh.
+
+    The reference carried exactly this architecture — PipeDream stage
+    machinery + sparse allreduce within each stage's DP group — but
+    shipped it disabled (stage maps commented out, configs single-stage;
+    SURVEY.md §2.3). Composition: each data row computes its own gradient
+    (the loss stays row-local, ``data_axis=None``), every pipe rank runs
+    the sparse collective over ``data`` on its stage's flat gradient with
+    its own SparseState (the reference's per-merged-group compression),
+    and the shared embeddings/heads bucket reduces over ``data`` after the
+    pipe-psum gather. Params/opt/sparse states use the per-data-rank
+    replica layout (leading [dp]; see bert_seq.build_seq_sparse_train_step
+    for why VMA tracking requires it): stage_stack [dp, S, ...], shared
+    [dp, ...]. Use :func:`init_pipeline_sparse_states`."""
+    from oktopk_tpu.collectives.registry import get_algorithm
+    from oktopk_tpu.ops.compaction import resolve_use_pallas
+
+    M = num_microbatches
+    algo_cfg = resolve_use_pallas(algo_cfg, mesh)
+    algo_cfg = algo_cfg.replace(num_workers=int(mesh.shape["data"]))
+    algo = get_algorithm(compressor, warmup=warmup)
+
+    def shard_fn(params, sstates, opt_states, batch, rng):
+        stage_stack, shared = params
+        stage_ss, shared_ss = sstates
+        opt_stage_st, opt_shared_st = opt_states
+        row2 = lambda t: jax.tree.map(lambda x: x[0, 0], t)
+        row = lambda t: jax.tree.map(lambda x: x[0], t)
+        my_stage = row2(stage_stack)
+        shared_l = row(shared)
+        my_stage_ss, my_shared_ss = row2(stage_ss), row(shared_ss)
+        my_opt, opt_shared = row2(opt_stage_st), row(opt_shared_st)
+        r = jax.random.fold_in(rng, lax.axis_index("data"))
+        rngs = {"dropout": r}
+
+        def loss_fn(my_stage_, shared_):
+            ids = batch["input_ids"]
+            h0 = staged.embed(shared_, ids, batch["token_type_ids"], True,
+                              rngs=rngs)
+            mask_mb = _microbatch(
+                staged.attn_mask(batch["attention_mask"]), M)
+            h0_mb = _microbatch(h0, M)
+
+            def stage_fn(p, x, stage, mb_idx):
+                m = lax.dynamic_index_in_dim(mask_mb, mb_idx, 0,
+                                             keepdims=False)
+                return staged.apply_stage(p, x, m, True, rngs=rngs)
+
+            outs = gpipe_apply(stage_fn, my_stage_, h0_mb, "pipe", M,
+                               remat=remat)
+            h = outs.reshape(ids.shape[0], ids.shape[1], -1)
+            mlm, nsp = staged.head_logits(shared_, h, True)
+            return _global_pretrain_loss(mlm, nsp, batch, None)
+
+        loss, (g_stage, g_shared) = jax.value_and_grad(
+            loss_fn, argnums=(0, 1))(my_stage, shared_l)
+        # Per-row grads: the shared params are pipe-invariant, so the AD
+        # transpose already completes their cotangents over pipe (an
+        # explicit pipe psum would overcount by pp — same hazard as the
+        # dense step's former data psums); stage grads are complete for
+        # this data row by construction. Only the data-axis reduction
+        # remains, and that is the sparse collective's job.
+
+        cfg_stage = algo_cfg.replace(
+            n=int(sum(x.size for x in jax.tree.leaves(g_stage))))
+        cfg_shared = algo_cfg.replace(
+            n=int(sum(x.size for x in jax.tree.leaves(g_shared))))
+        flat_s, leaves_s, td_s = flatten_tree(g_stage)
+        red_s, my_stage_ss = algo(flat_s, my_stage_ss, cfg_stage, "data")
+        g_stage = unflatten_tree(red_s, leaves_s, td_s)
+        flat_h, leaves_h, td_h = flatten_tree(g_shared)
+        red_h, my_shared_ss = algo(flat_h, my_shared_ss, cfg_shared,
+                                   "data")
+        g_shared = unflatten_tree(red_h, leaves_h, td_h)
+
+        upd_s, my_opt = optimizer.update(g_stage, my_opt, my_stage)
+        my_stage = jax.tree.map(jnp.add, my_stage, upd_s)
+        upd_h, opt_shared = optimizer.update(g_shared, opt_shared,
+                                             shared_l)
+        shared_l = jax.tree.map(jnp.add, shared_l, upd_h)
+
+        lead2 = lambda t: jax.tree.map(lambda x: x[None, None], t)
+        lead = lambda t: jax.tree.map(lambda x: x[None], t)
+        vol = my_stage_ss.last_volume + my_shared_ss.last_volume
+
+        def pmean_varying(x):
+            # reduce only over axes the value actually varies on (the loss
+            # is already pipe-invariant via the pipeline's final broadcast)
+            ax = tuple(a for a in ("data", "pipe")
+                       if a in jax.typeof(x).vma)
+            return lax.pmean(x, ax) if ax else x
+
+        metrics = {"loss": pmean_varying(loss),
+                   "comm_volume": pmean_varying(vol)}
+        return ((lead2(my_stage), lead(shared_l)),
+                (lead2(my_stage_ss), lead(my_shared_ss)),
+                (lead2(my_opt), lead(opt_shared)), metrics)
+
+    spec_b = P("data")
+    batch_specs = {k: spec_b for k in ("input_ids", "token_type_ids",
+                                       "attention_mask", "mlm_labels",
+                                       "nsp_labels")}
+    dp2 = P("data", "pipe")
+    mapped = jax.shard_map(
+        shard_fn, mesh=mesh,
+        in_specs=((dp2, P("data")), (dp2, P("data")),
+                  (dp2, P("data")), batch_specs, P()),
+        out_specs=((dp2, P("data")), (dp2, P("data")),
+                   (dp2, P("data")), P()),
+        check_vma=True)
+    return jax.jit(mapped, donate_argnums=(0, 1, 2))

@@ -35,6 +35,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 from oktopk_tpu.models.bert import BertConfig
 from oktopk_tpu.parallel.ring_attention import ring_attention
 from oktopk_tpu.train import losses  # noqa: F401  (doc cross-ref)
+from oktopk_tpu.utils.flatten import flatten_tree, unflatten_tree
 
 
 def _layer_norm(p, x, eps):
@@ -227,15 +228,10 @@ def build_seq_sparse_train_step(cfg: BertConfig, mesh: Mesh, optimizer,
         loss, grads = jax.value_and_grad(
             lambda p: bert_seq_loss(p, batch, cfg, axis_name,
                                     data_axis=None))(params)
-        leaves, treedef = jax.tree.flatten(grads)
-        flat = jnp.concatenate([x.reshape(-1) for x in leaves])
+        flat, leaves, treedef = flatten_tree(grads)
         assert flat.size == algo_cfg.n, (flat.size, algo_cfg.n)
         reduced, sp = algo(flat, sp, algo_cfg, data_axis)
-        off, results = 0, []
-        for x in leaves:
-            results.append(reduced[off:off + x.size].reshape(x.shape))
-            off += x.size
-        grads = jax.tree.unflatten(treedef, results)
+        grads = unflatten_tree(reduced, leaves, treedef)
         updates, opt_state = optimizer.update(grads, opt_state, params)
         params = jax.tree.map(jnp.add, params, updates)
         # loss is already seq-invariant (the loss psums), so only the

@@ -202,9 +202,40 @@ def run_pipeline(args):
     stack, shared = staged.split(params)
     opt = bert_adam(lr=args.lr, warmup=args.warmup_proportion,
                     t_total=args.num_minibatches)
-    opt_states = init_pipeline_opt_state(opt, stack, shared)
-    step = build_pipeline_train_step(
-        staged, mesh, num_microbatches=args.num_microbatches, optimizer=opt)
+
+    sparse = args.compressor != "dense"
+    if sparse:
+        # composed sparse DP x pipeline: per-data-rank replica layout
+        # (the architecture the reference shipped disabled — PipeDream
+        # stages + per-stage-group sparse allreduce, SURVEY.md 2.3)
+        import jax.numpy as jnp
+
+        from oktopk_tpu.parallel.bert_pipeline import (
+            build_pipeline_sparse_train_step, init_pipeline_sparse_states)
+        from oktopk_tpu.parallel.bert_seq import stack_replicas
+        if dp < 2:
+            raise SystemExit("sparse pipeline composition needs a data "
+                             "axis (more devices than --pipeline-stages) "
+                             "— or pass --compressor dense")
+        acfg = _bert_algo_cfg(args, density=args.density)
+        stage_ss, shared_ss = init_pipeline_sparse_states(
+            stack, shared, acfg, dp)
+        opt_states = (stack_replicas(jax.vmap(opt.init)(stack), dp),
+                      stack_replicas(opt.init(shared), dp))
+        stack = stack_replicas(stack, dp)
+        shared = stack_replicas(shared, dp)
+        sstates = (stage_ss, shared_ss)
+        step0 = build_pipeline_sparse_train_step(
+            staged, mesh, num_microbatches=args.num_microbatches,
+            optimizer=opt, algo_cfg=acfg, compressor=args.compressor,
+            warmup=False)
+        logger.info("sparse pipeline: compressor=%s density=%g",
+                    args.compressor, args.density)
+    else:
+        opt_states = init_pipeline_opt_state(opt, stack, shared)
+        step0 = build_pipeline_train_step(
+            staged, mesh, num_microbatches=args.num_microbatches,
+            optimizer=opt)
 
     global_bs = args.batch_size * dp * args.num_microbatches
     data_iter, meta = make_dataset("wikipedia", args.model, global_bs,
@@ -218,8 +249,13 @@ def run_pipeline(args):
     t0 = time.time()
     for i in range(args.num_minibatches):
         rng, sub = jax.random.split(rng)
-        stack, shared, opt_states, m = step(stack, shared, opt_states,
-                                            next(data_iter), sub)
+        if sparse:
+            (stack, shared), sstates, opt_states, m = step0(
+                (stack, shared), sstates, opt_states,
+                next(data_iter), sub)
+        else:
+            stack, shared, opt_states, m = step0(stack, shared, opt_states,
+                                                 next(data_iter), sub)
         if (i + 1) % args.log_every == 0:
             dt = (time.time() - t0) / args.log_every
             logger.info("iter %d loss %.4f %.3fs/it", i + 1,
@@ -227,8 +263,13 @@ def run_pipeline(args):
             t0 = time.time()
     if args.ckpt_dir and jax.process_index() == 0:
         from oktopk_tpu.train.checkpoint import save_checkpoint
+        if sparse:   # row 0 of the replicas is the canonical copy
+            stack_c = jax.tree.map(lambda x: x[0], stack)
+            shared_c = jax.tree.map(lambda x: x[0], shared)
+        else:
+            stack_c, shared_c = stack, shared
         save_checkpoint(args.ckpt_dir,
-                        {"params": staged.merge(stack, shared),
+                        {"params": staged.merge(stack_c, shared_c),
                          "model_state": {}}, args.num_minibatches)
         logger.info("saved single-module-layout checkpoint to %s",
                     args.ckpt_dir)

@@ -1,0 +1,27 @@
+"""Flat-vector <-> pytree helpers for the sparse-allreduce seam.
+
+Every composed train step (optim/distributed.py buckets,
+parallel/bert_seq.py, parallel/bert_pipeline.py) flattens a gradient
+pytree into the collective's flat vector and scatters the reduced result
+back; one definition keeps the offset/reshape logic identical."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def flatten_tree(tree):
+    """-> (flat [n], leaves, treedef)."""
+    leaves, treedef = jax.tree.flatten(tree)
+    return (jnp.concatenate([x.reshape(-1) for x in leaves]), leaves,
+            treedef)
+
+
+def unflatten_tree(flat, leaves, treedef):
+    """Inverse of :func:`flatten_tree` (shapes from ``leaves``)."""
+    off, out = 0, []
+    for x in leaves:
+        out.append(flat[off:off + x.size].reshape(x.shape))
+        off += x.size
+    return jax.tree.unflatten(treedef, out)

@@ -120,3 +120,110 @@ class TestPipelineTraining:
             losses.append(float(m["loss"]))
         assert np.isfinite(losses).all()
         assert losses[-1] < losses[0]
+
+
+def make_equal_mask_batch(rng, vocab, masked_per_example=3):
+    """Every example has exactly the same masked-token count, making the
+    global weighted loss equal the mean of per-data-row weighted losses —
+    the regime where dense-composed and global-psum steps must agree."""
+    ids = rng.randint(0, vocab, size=(B, T)).astype(np.int32)
+    mlm = np.full((B, T), -1, np.int32)
+    for b in range(B):
+        cols = rng.choice(T, size=masked_per_example, replace=False)
+        mlm[b, cols] = ids[b, cols]
+    return {"input_ids": jnp.asarray(ids),
+            "token_type_ids": jnp.zeros((B, T), jnp.int32),
+            "attention_mask": jnp.ones((B, T), jnp.int32),
+            "mlm_labels": jnp.asarray(mlm),
+            "nsp_labels": jnp.asarray(
+                rng.randint(0, 2, size=(B,)).astype(np.int32))}
+
+
+class TestPipelineSparseComposition:
+    """Sparse DP x pipeline — the architecture the reference shipped
+    disabled (PipeDream stages + per-stage-group sparse allreduce)."""
+
+    def _setup(self, staged, params, compressor):
+        from oktopk_tpu.config import OkTopkConfig
+        from oktopk_tpu.optim.sgd import sgd
+        from oktopk_tpu.parallel.bert_pipeline import (
+            build_pipeline_sparse_train_step, init_pipeline_sparse_states)
+
+        dp, pp, M = 2, 2, 2
+        mesh = make_pipeline_mesh(pp, devices=jax.devices()[: dp * pp])
+        stack, shared = staged.split(params)
+        acfg = OkTopkConfig(density=0.05, warmup_steps=0,
+                            use_pallas=False)
+        stage_ss, shared_ss = init_pipeline_sparse_states(
+            stack, shared, acfg, dp)
+        opt = sgd(lr=0.1)
+
+        def rep2(t):
+            return jax.tree.map(
+                lambda x: jnp.broadcast_to(x, (dp,) + x.shape), t)
+
+        pstack = rep2(stack)
+        pshared = rep2(shared)
+        opt_states = (rep2(jax.vmap(opt.init)(stack)),
+                      rep2(opt.init(shared)))
+        step = build_pipeline_sparse_train_step(
+            staged, mesh, num_microbatches=M, optimizer=opt,
+            algo_cfg=acfg, compressor=compressor, warmup=False)
+        return (step, (pstack, pshared), (stage_ss, shared_ss),
+                opt_states, opt, mesh, M, dp)
+
+    def test_dense_composition_matches_global_step(self, staged, params):
+        """With equal per-example mask counts, mean-of-row-gradients ==
+        gradient of the global weighted loss, so the composed dense step
+        must land on the same params as build_pipeline_train_step."""
+        (step, p0, ss, opts, opt, mesh, M, dp) = self._setup(
+            staged, params, "dense")
+        batch = make_equal_mask_batch(np.random.RandomState(21),
+                                      staged.cfg.vocab_size)
+        rng = jax.random.PRNGKey(7)
+        (pstack2, pshared2), _, _, m = step(p0, ss, opts, batch, rng)
+        assert np.isfinite(float(m["loss"]))
+
+        stack, shared = staged.split(params)
+        ref_step = build_pipeline_train_step(
+            staged, mesh, num_microbatches=M,
+            optimizer=__import__("oktopk_tpu.optim.sgd",
+                                 fromlist=["sgd"]).sgd(lr=0.1))
+        opt_ref = init_pipeline_opt_state(
+            __import__("oktopk_tpu.optim.sgd", fromlist=["sgd"]).sgd(
+                lr=0.1), stack, shared)
+        stack_r, shared_r, _, m_r = ref_step(stack, shared, opt_ref,
+                                             batch, rng)
+        for (pa, a), (_, b) in zip(
+                jax.tree_util.tree_leaves_with_path(stack_r),
+                jax.tree_util.tree_leaves_with_path(
+                    jax.tree.map(lambda x: x[0], pstack2))):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), atol=2e-5,
+                err_msg=jax.tree_util.keystr(pa))
+        for (pa, a), (_, b) in zip(
+                jax.tree_util.tree_leaves_with_path(shared_r),
+                jax.tree_util.tree_leaves_with_path(
+                    jax.tree.map(lambda x: x[0], pshared2))):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), atol=2e-5,
+                err_msg=jax.tree_util.keystr(pa))
+
+    def test_oktopk_composition_trains(self, staged, params):
+        (step, p, ss, opts, opt, mesh, M, dp) = self._setup(
+            staged, params, "oktopk")
+        batch = make_batch(np.random.RandomState(22),
+                           staged.cfg.vocab_size)
+        rng = jax.random.PRNGKey(8)
+        n_total = sum(x.size for x in jax.tree.leaves(params))
+        for i in range(3):
+            p, ss, opts, m = step(p, ss, opts, batch, rng)
+            assert np.isfinite(float(m["loss"]))
+        stage_ss, shared_ss = ss
+        assert int(np.asarray(stage_ss.step)[0, 0]) == 3
+        vol = float(m["comm_volume"])
+        assert 0 < vol < 2.0 * n_total, vol
+        # replicas identical across data ranks
+        for leaf in jax.tree.leaves(p[0]):
+            np.testing.assert_array_equal(np.asarray(leaf[0]),
+                                          np.asarray(leaf[1]))
