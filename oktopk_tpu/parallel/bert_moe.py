@@ -111,13 +111,18 @@ def _attention(p, x, attn_mask):
         + p["out"]["bias"]
 
 
-def moe_ffn(experts_local, gate, x, mcfg: MoEConfig, axis_name):
+def moe_ffn(experts_local, gate, x, mcfg: MoEConfig, axis_name,
+            stats_axes=None):
     """GShard top-1 MoE FFN inside ``shard_map``.
 
     experts_local: this rank's expert stack (leaves [E_local, ...]);
     gate [H, E] replicated; x [b, T, H] this rank's batch shard. Returns
-    (y [b, T, H], aux_loss scalar — the global Switch load-balance term).
-    """
+    (y [b, T, H], aux_loss scalar — the Switch load-balance term with
+    f/p statistics averaged over ``stats_axes``, default the expert axis
+    only). The aux is NONLINEAR in f/p (sum of products), so global
+    semantics require globally averaged STATS — a mean of per-shard aux
+    values is a different objective (mean of products != product of
+    means)."""
     Pn = lax.axis_size(axis_name)
     E = mcfg.num_experts
     e_local = experts_local["wi"].shape[0]
@@ -133,9 +138,10 @@ def moe_ffn(experts_local, gate, x, mcfg: MoEConfig, axis_name):
     g = jnp.take_along_axis(probs, e_star[:, None], 1)[:, 0]
 
     # Switch load-balance aux: E * sum_e f_e * p_e, f/p averaged globally
+    axes = (axis_name,) if stats_axes is None else stats_axes
     onehot = jax.nn.one_hot(e_star, E, dtype=xt.dtype)      # [n, E]
-    f_e = lax.pmean(jnp.mean(onehot, axis=0), axis_name)
-    p_e = lax.pmean(jnp.mean(probs, axis=0), axis_name)
+    f_e = lax.pmean(jnp.mean(onehot, axis=0), axes)
+    p_e = lax.pmean(jnp.mean(probs, axis=0), axes)
     aux = E * jnp.sum(f_e * p_e)
 
     # position of each token within its expert's capacity (per source rank)
@@ -181,17 +187,22 @@ def all_to_all_leading_back(y, Pn, e_local, axis_name):
 
 def bert_moe_loss(moe_layers, shared, batch, cfg: BertConfig,
                   mcfg: MoEConfig, axis_name: str = "expert",
-                  data_axis=None):
+                  data_axis=None, stats_data_axis=None):
     """Batch-sharded MLM+NSP+aux loss with expert-parallel MoE FFNs
     (inside shard_map; ``moe_layers`` leaves are this rank's expert
     shards, ``batch`` leaves this rank's batch shard). With ``data_axis``
     (the composed data x expert mesh) experts are replicated over data —
     their gradients psum across it in the shard_map transpose — and the
     loss reductions span both axes; the dispatch all_to_all stays within
-    each data row's expert group."""
+    each data row's expert group. ``stats_data_axis`` extends the aux
+    f/p statistics over a data axis even when the MLM/NSP reductions stay
+    row-local (``data_axis=None``) — the sparse composition needs
+    per-row losses but the GLOBAL load-balance objective."""
     import optax
 
     axes = (axis_name,) if data_axis is None else (data_axis, axis_name)
+    sda = stats_data_axis if stats_data_axis is not None else data_axis
+    stats_axes = (axis_name,) if sda is None else (axis_name, sda)
 
     ids = batch["input_ids"]
     B, T = ids.shape
@@ -209,9 +220,8 @@ def bert_moe_loss(moe_layers, shared, batch, cfg: BertConfig,
         sh = shared["layers"][f"layer_{i}"]
         y = _attention(sh["attention"], x, mask)
         x = _layer_norm(sh["attention_ln"], x + y, cfg.layer_norm_eps)
-        h, aux = moe_ffn(lp, sh["gate"], x, mcfg, axis_name)
-        if data_axis is not None:
-            aux = lax.pmean(aux, data_axis)   # f/p stats global over data
+        h, aux = moe_ffn(lp, sh["gate"], x, mcfg, axis_name,
+                         stats_axes=stats_axes)
         aux_total = aux_total + aux
         x = _layer_norm(sh["output_ln"], x + h, cfg.layer_norm_eps)
 
@@ -271,6 +281,116 @@ def build_moe_loss(cfg: BertConfig, mcfg: MoEConfig, mesh: Mesh,
                            in_specs=(P(axis_name), P(), batch_spec),
                            out_specs=P())
     return jax.jit(mapped)
+
+
+def build_moe_sparse_train_step(cfg: BertConfig, mcfg: MoEConfig,
+                                mesh: Mesh, optimizer, algo_cfg,
+                                compressor: str = "oktopk",
+                                warmup: bool = True,
+                                axis_name: str = "expert",
+                                data_axis: str = "data"):
+    """Sparse DP composed with expert parallelism: jit ``((moe, shared),
+    (moe_sstate, shared_sstate), opt_state, batch) -> (...)`` on a
+    (data, expert) mesh.
+
+    Completes the sparse x {seq, pipe, expert} composition matrix. Each
+    data row computes its own gradient (the loss psums span the expert
+    axis only), then two sparse collectives run over ``data``: one on the
+    row's local expert-shard flat gradient (per-(data rank, expert shard)
+    SparseState), one on the shared bucket (whose cotangents arrive
+    expert-complete from the AD transpose — no explicit psum, see
+    bert_pipeline.py). Replica layout as in the other compositions:
+    moe leaves [dp, E, ...] (sharded data x expert), shared [dp, ...]."""
+    from oktopk_tpu.collectives.registry import get_algorithm
+    from oktopk_tpu.ops.compaction import resolve_use_pallas
+    from oktopk_tpu.utils.flatten import flatten_tree, unflatten_tree
+
+    algo_cfg = resolve_use_pallas(algo_cfg, mesh)
+    algo_cfg = algo_cfg.replace(num_workers=int(mesh.shape[data_axis]))
+    algo = get_algorithm(compressor, warmup=warmup)
+
+    def shard_fn(params, sstates, opt_states, batch):
+        moe, shared = params
+        moe_ss, shared_ss = sstates
+        opt_moe_st, opt_shared_st = opt_states
+        row = lambda t: jax.tree.map(lambda x: x[0], t)
+        moe_l, shared_l = row(moe), row(shared)
+        my_moe_ss = jax.tree.map(lambda x: x[0, 0], moe_ss)
+        my_shared_ss = row(shared_ss)
+        # moe opt state is vmapped-per-expert (init_moe_sparse_opt), so
+        # its every leaf carries the expert dim the spec shards
+        opt_moe, opt_shared = row(opt_moe_st), row(opt_shared_st)
+
+        loss, (g_moe, g_shared) = jax.value_and_grad(
+            lambda m, s: bert_moe_loss(m, s, batch, cfg, mcfg, axis_name,
+                                       data_axis=None,
+                                       stats_data_axis=data_axis),
+            argnums=(0, 1))(moe_l, shared_l)
+
+        flat_m, leaves_m, td_m = flatten_tree(g_moe)
+        cfg_m = algo_cfg.replace(n=int(flat_m.size))
+        red_m, my_moe_ss = algo(flat_m, my_moe_ss, cfg_m, data_axis)
+        g_moe = unflatten_tree(red_m, leaves_m, td_m)
+        flat_s, leaves_s, td_s = flatten_tree(g_shared)
+        cfg_s = algo_cfg.replace(n=int(flat_s.size))
+        red_s, my_shared_ss = algo(flat_s, my_shared_ss, cfg_s, data_axis)
+        g_shared = unflatten_tree(red_s, leaves_s, td_s)
+
+        upd_m, opt_moe = jax.vmap(optimizer.update)(g_moe, opt_moe, moe_l)
+        moe_l = jax.tree.map(jnp.add, moe_l, upd_m)
+        upd_s, opt_shared = optimizer.update(g_shared, opt_shared,
+                                             shared_l)
+        shared_l = jax.tree.map(jnp.add, shared_l, upd_s)
+
+        unrow = lambda t: jax.tree.map(lambda x: x[None], t)
+        vol = my_moe_ss.last_volume + my_shared_ss.last_volume
+        return ((unrow(moe_l), unrow(shared_l)),
+                (jax.tree.map(lambda x: x[None, None], my_moe_ss),
+                 unrow(my_shared_ss)),
+                (unrow(opt_moe), unrow(opt_shared)),
+                {"loss": lax.pmean(loss, data_axis),
+                 "comm_volume": lax.pmean(vol, (data_axis, axis_name))})
+
+    de = P(data_axis, axis_name)
+    mapped = jax.shard_map(
+        shard_fn, mesh=mesh,
+        in_specs=((de, P(data_axis)), (de, P(data_axis)),
+                  (de, P(data_axis)), P((data_axis, axis_name))),
+        out_specs=((de, P(data_axis)), (de, P(data_axis)),
+                   (de, P(data_axis)), P()),
+        check_vma=True)
+    return jax.jit(mapped, donate_argnums=(0, 1, 2))
+
+
+def init_moe_sparse_states(moe, shared, algo_cfg, dp: int, num_shards: int):
+    """Sparse states for :func:`build_moe_sparse_train_step`: the MoE
+    bucket state per (data rank, expert shard) — [dp, Pe, ...] — sized to
+    the LOCAL expert-shard flat gradient; the shared bucket [dp, ...]."""
+    from oktopk_tpu.collectives.state import init_state
+
+    n_moe_total = int(sum(x.size for x in jax.tree.leaves(moe)))
+    assert n_moe_total % num_shards == 0, (n_moe_total, num_shards)
+    cfg_m = algo_cfg.replace(n=n_moe_total // num_shards, num_workers=dp)
+    cfg_s = algo_cfg.replace(
+        n=int(sum(x.size for x in jax.tree.leaves(shared))),
+        num_workers=dp)
+
+    def stack(s, lead):
+        return jax.tree.map(
+            lambda x: jnp.broadcast_to(x, lead + x.shape), s)
+
+    return (stack(init_state(cfg_m), (dp, num_shards)),
+            stack(init_state(cfg_s), (dp,)))
+
+
+def init_moe_sparse_opt(optimizer, moe, shared, dp: int):
+    """Replica-layout optimizer states: the MoE state vmapped over the
+    expert dim (every leaf then carries it, so one (data, expert) spec
+    covers moments AND step counters), the shared state plain; both
+    stacked [dp, ...]."""
+    from oktopk_tpu.parallel.bert_seq import stack_replicas
+    return (stack_replicas(jax.vmap(optimizer.init)(moe), dp),
+            stack_replicas(optimizer.init(shared), dp))
 
 
 def build_moe_train_step(cfg: BertConfig, mcfg: MoEConfig, mesh: Mesh,

@@ -63,6 +63,11 @@ def parse_args(argv=None):
                         "SURVEY.md 2.3); 1 = off")
     p.add_argument("--num-experts", type=int, default=0,
                    help="experts per MoE layer (default: = expert-shards)")
+    p.add_argument("--expert-data-shards", type=int, default=1,
+                   help="data axis of the composed data x expert mesh: "
+                        "sparse-allreduce DP (any --compressor) riding "
+                        "with the MoE dispatch; 1 = pure expert mesh "
+                        "(dense only)")
     p.add_argument("--capacity-factor", type=float, default=1.25,
                    help="MoE token capacity per expert, as a multiple of "
                         "the even-routing share")
@@ -428,14 +433,12 @@ def run_expert_parallel(args):
     E = args.num_experts or args.expert_shards
     if E % args.expert_shards:
         raise SystemExit("--num-experts must divide by --expert-shards")
-    if args.batch_size % args.expert_shards:
-        raise SystemExit("--batch-size must divide by --expert-shards")
-    if args.compressor != "dense":
+    dpx = args.expert_data_shards
+    if args.compressor != "dense" and dpx <= 1:
         raise SystemExit(
-            "--expert-shards trains with dense gradients (expert shards "
-            "already minimise comm via top-1 dispatch; composing the "
-            "sparse collectives needs a data axis) — pass "
-            "--compressor dense")
+            "sparse collectives over a pure expert mesh have no data axis "
+            "to reduce over — add --expert-data-shards N for the composed "
+            "data x expert mesh, or pass --compressor dense")
     if args.gradient_accumulation_steps != 1:
         raise SystemExit("--gradient-accumulation-steps is not wired into "
                          "the expert-parallel path yet")
@@ -444,10 +447,12 @@ def run_expert_parallel(args):
            "bert_tiny": BertConfig.tiny}[args.model](dtype=dtype)
     mcfg = MoEConfig(num_experts=E,
                      capacity_factor=args.capacity_factor)
-    mesh = make_moe_mesh(args.expert_shards)
+    mesh = make_moe_mesh(args.expert_shards, data_size=dpx)
     logger.info("expert-parallel MoE BERT: %s, %d experts over %d shards "
-                "(cap factor %.2f)", args.model, E, args.expert_shards,
-                args.capacity_factor)
+                "(cap factor %.2f)%s", args.model, E, args.expert_shards,
+                args.capacity_factor,
+                f", data axis dp={dpx} compressor={args.compressor}"
+                if dpx > 1 else "")
 
     ex = jnp.zeros((2, args.max_seq_length), jnp.int32)
     rng = jax.random.PRNGKey(args.seed)
@@ -460,11 +465,41 @@ def run_expert_parallel(args):
                                 seed=args.seed)
     opt = bert_adam(lr=args.lr, warmup=args.warmup_proportion,
                     t_total=args.num_minibatches)
+    # --batch-size is per-worker (as in the DP/pipeline paths); the MoE
+    # batch is sharded over the (data x) expert axes, so request global
+    global_bs = args.batch_size * args.expert_shards * dpx
+
+    if dpx > 1 and args.compressor != "dense":
+        # composed sparse DP x expert: per-data-rank replica layout
+        from oktopk_tpu.parallel.bert_moe import (
+            build_moe_sparse_train_step, init_moe_sparse_opt,
+            init_moe_sparse_states)
+        from oktopk_tpu.parallel.bert_seq import stack_replicas
+        moe, shared = params
+        acfg = _bert_algo_cfg(args, density=args.density)
+        sstep = build_moe_sparse_train_step(
+            cfg, mcfg, mesh, opt, acfg, compressor=args.compressor,
+            warmup=False)
+        carry = ((stack_replicas(moe, dpx), stack_replicas(shared, dpx)),
+                 init_moe_sparse_states(moe, shared, acfg, dpx,
+                                        args.expert_shards))
+        opt_state = init_moe_sparse_opt(opt, moe, shared, dpx)
+
+        def step_fn(ps, opt_st, batch):
+            pr, ss = ps
+            pr, ss, opt_st, m = sstep(pr, ss, opt_st, batch)
+            return (pr, ss), opt_st, m["loss"]
+
+        _pretrain_loop(
+            args, logger, step_fn, carry, opt_state, global_bs,
+            lambda ps: {"moe_params": {
+                "layers": jax.tree.map(lambda x: x[0], ps[0][0]),
+                "shared": jax.tree.map(lambda x: x[0], ps[0][1])},
+                "model_state": {}})
+        return 0
+
     opt_state = opt.init(params)
     step = build_moe_train_step(cfg, mcfg, mesh, opt)
-    # --batch-size is per-worker (as in the DP/pipeline paths); the MoE
-    # batch is sharded over the expert axis, so request the global batch
-    global_bs = args.batch_size * args.expert_shards
     # MoE params cannot collapse to the single-module layout once the
     # experts diverge — save them under a distinct key so nothing mistakes
     # the tuple for BertForPreTraining params

@@ -154,3 +154,95 @@ class TestBertExpertParallel:
         l1 = float(loss_fn(moe, shared, batch))
         l2 = float(loss_fn(moe, shared, batch))
         assert np.isfinite(l1) and l1 == l2
+
+
+def make_equal_mask_batch(rng, vocab, masked_per_example=3):
+    ids = rng.randint(0, vocab, size=(B, T)).astype(np.int32)
+    mlm = np.full((B, T), -1, np.int32)
+    for b in range(B):
+        cols = rng.choice(T, size=masked_per_example, replace=False)
+        mlm[b, cols] = ids[b, cols]
+    return {"input_ids": jnp.asarray(ids),
+            "token_type_ids": jnp.zeros((B, T), jnp.int32),
+            "attention_mask": jnp.ones((B, T), jnp.int32),
+            "mlm_labels": jnp.asarray(mlm),
+            "nsp_labels": jnp.asarray(
+                rng.randint(0, 2, size=(B,)).astype(np.int32))}
+
+
+class TestMoESparseComposition:
+    """Sparse DP x expert parallelism — completes sparse x {seq, pipe,
+    expert}."""
+
+    def _setup(self, cfg, params, compressor):
+        from oktopk_tpu.config import OkTopkConfig
+        from oktopk_tpu.optim.sgd import sgd
+        from oktopk_tpu.parallel.bert_moe import (
+            build_moe_sparse_train_step, init_moe_sparse_opt,
+            init_moe_sparse_states)
+        from oktopk_tpu.parallel.bert_seq import stack_replicas
+
+        dp, ep = 2, 4
+        moe, shared = experts_from_dense(params, E, gate_scale=0.5, seed=3)
+        moe = perturb(moe)
+        mcfg = MoEConfig(num_experts=E, capacity_factor=float(E))
+        mesh = make_moe_mesh(ep, data_size=dp)
+        acfg = OkTopkConfig(density=0.05, warmup_steps=0,
+                            use_pallas=False)
+        opt = sgd(lr=0.1)
+        step = build_moe_sparse_train_step(
+            cfg, mcfg, mesh, opt, acfg, compressor=compressor,
+            warmup=False)
+        sstates = init_moe_sparse_states(moe, shared, acfg, dp, ep)
+        opts = init_moe_sparse_opt(opt, moe, shared, dp)
+        pstack = (stack_replicas(moe, dp), stack_replicas(shared, dp))
+        return step, pstack, sstates, opts, (moe, shared), mcfg, opt
+
+    def test_dense_composition_matches_expert_only_step(self, cfg, params):
+        """Equal per-row mask counts: mean-of-row gradients == global
+        gradient, so the composed dense step must land on the same params
+        as the expert-only build_moe_train_step."""
+        from oktopk_tpu.parallel.bert_moe import build_moe_train_step
+
+        (step, pstack, sstates, opts, (moe, shared), mcfg,
+         opt) = self._setup(cfg, params, "dense")
+        batch = make_equal_mask_batch(np.random.RandomState(31),
+                                      cfg.vocab_size)
+        (p_moe, p_sh), _, _, m = step(pstack, sstates, opts, batch)
+        assert np.isfinite(float(m["loss"]))
+
+        ref_step = build_moe_train_step(cfg, mcfg, make_moe_mesh(4), opt)
+        (r_moe, r_sh), _, _ = ref_step((moe, shared),
+                                       opt.init((moe, shared)), batch)
+        for (pa, a), (_, b) in zip(
+                jax.tree_util.tree_leaves_with_path(r_moe),
+                jax.tree_util.tree_leaves_with_path(
+                    jax.tree.map(lambda x: x[0], p_moe))):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), atol=2e-6,
+                err_msg=jax.tree_util.keystr(pa))
+        for (pa, a), (_, b) in zip(
+                jax.tree_util.tree_leaves_with_path(r_sh),
+                jax.tree_util.tree_leaves_with_path(
+                    jax.tree.map(lambda x: x[0], p_sh))):
+            # tight: with the aux f/p stats global over data, the dense
+            # composition equals the expert-only step to float noise
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), atol=2e-6,
+                err_msg=jax.tree_util.keystr(pa))
+
+    def test_oktopk_composition_trains(self, cfg, params):
+        (step, p, ss, opts, (moe, shared), mcfg, opt) = self._setup(
+            cfg, params, "oktopk")
+        batch = make_batch(np.random.RandomState(32), cfg.vocab_size)
+        n_total = sum(x.size for x in jax.tree.leaves((moe, shared)))
+        for i in range(3):
+            p, ss, opts, m = step(p, ss, opts, batch)
+            assert np.isfinite(float(m["loss"]))
+        moe_ss, _ = ss
+        assert int(np.asarray(moe_ss.step)[0, 0]) == 3
+        vol = float(m["comm_volume"])
+        assert 0 < vol < 2.0 * n_total, vol
+        for leaf in jax.tree.leaves(p):
+            np.testing.assert_array_equal(np.asarray(leaf[0]),
+                                          np.asarray(leaf[1]))
