@@ -191,7 +191,8 @@ def build_seq_sparse_train_step(cfg: BertConfig, mesh: Mesh, optimizer,
                                 algo_cfg, compressor: str = "oktopk",
                                 warmup: bool = True,
                                 axis_name: str = "seq",
-                                data_axis: str = "data"):
+                                data_axis: str = "data",
+                                accum_steps: int = 1):
     """Sparse data parallelism composed with sequence parallelism: jit
     ``(params, sparse_state, opt_state, batch) -> (params, sparse_state,
     opt_state, loss)`` on a (data, seq) mesh.
@@ -213,7 +214,13 @@ def build_seq_sparse_train_step(cfg: BertConfig, mesh: Mesh, optimizer,
     ring-attention / loss-psum gradient transposes are only exact under
     ``check_vma=True``. ``algo_cfg.num_workers`` must equal the data axis
     size and ``algo_cfg.n`` the flat parameter count. Use
-    :func:`stack_replicas` to lift single-copy pytrees."""
+    :func:`stack_replicas` to lift single-copy pytrees.
+
+    ``accum_steps > 1`` runs local gradient accumulation before the ONE
+    collective (the reference's --gradient_accumulation_steps x
+    update_interval semantics, BERT/bert/main_bert.py:914-918): batch
+    leaves carry ``accum_steps * b`` examples per data rank and are
+    consumed as a ``lax.scan`` over slices."""
     from oktopk_tpu.collectives.registry import get_algorithm
     from oktopk_tpu.ops.compaction import resolve_use_pallas
 
@@ -225,9 +232,34 @@ def build_seq_sparse_train_step(cfg: BertConfig, mesh: Mesh, optimizer,
         row = lambda t: jax.tree.map(lambda x: x[0], t)
         unrow = lambda t: jax.tree.map(lambda x: x[None], t)
         params, sp, opt_state = row(params), row(sstate), row(opt_state)
-        loss, grads = jax.value_and_grad(
-            lambda p: bert_seq_loss(p, batch, cfg, axis_name,
-                                    data_axis=None))(params)
+
+        def one(p, b):
+            return jax.value_and_grad(
+                lambda q: bert_seq_loss(q, b, cfg, axis_name,
+                                        data_axis=None))(p)
+
+        if accum_steps > 1:
+            mb = jax.tree.map(
+                lambda x: x.reshape((accum_steps, x.shape[0] // accum_steps)
+                                    + x.shape[1:]), batch)
+
+            def body(carry, b):
+                g_acc, l_acc = carry
+                loss_i, g_i = one(params, b)
+                return (jax.tree.map(jnp.add, g_acc, g_i),
+                        l_acc + loss_i), None
+
+            # seed the carry with slice 0 so its VMA type matches the
+            # per-slice grads from the start (a zeros-init carry is
+            # invariant and lax.scan rejects the type change)
+            first = jax.tree.map(lambda x: x[0], mb)
+            rest = jax.tree.map(lambda x: x[1:], mb)
+            loss0, g0 = one(params, first)
+            (grads, loss), _ = lax.scan(body, (g0, loss0), rest)
+            grads = jax.tree.map(lambda g: g / accum_steps, grads)
+            loss = loss / accum_steps
+        else:
+            loss, grads = one(params, batch)
         flat, leaves, treedef = flatten_tree(grads)
         assert flat.size == algo_cfg.n, (flat.size, algo_cfg.n)
         reduced, sp = algo(flat, sp, algo_cfg, data_axis)

@@ -349,9 +349,11 @@ def run_seq_parallel(args):
             "sparse collectives over a pure seq mesh have no data axis to "
             "reduce over — add --seq-data-shards N for the composed "
             "data x seq mesh, or pass --compressor dense")
-    if args.gradient_accumulation_steps != 1:
-        raise SystemExit("--gradient-accumulation-steps is not wired into "
-                         "the seq-parallel path yet")
+    if args.gradient_accumulation_steps != 1 and not (
+            dp > 1 and args.compressor != "dense"):
+        raise SystemExit("--gradient-accumulation-steps on the seq path "
+                         "needs the composed sparse form "
+                         "(--seq-data-shards N, sparse --compressor)")
     import dataclasses
     dtype = jnp.dtype(args.compute_dtype)
     cfg = {"bert_base": BertConfig.base, "bert_large": BertConfig.large,
@@ -385,9 +387,10 @@ def run_seq_parallel(args):
         n = sum(x.size for x in jax.tree.leaves(params))
         acfg = _bert_algo_cfg(args, n=n, num_workers=dp,
                               density=args.density)
-        sstep = build_seq_sparse_train_step(cfg, mesh, opt, acfg,
-                                            compressor=args.compressor,
-                                            warmup=False)
+        sstep = build_seq_sparse_train_step(
+            cfg, mesh, opt, acfg, compressor=args.compressor,
+            warmup=False,
+            accum_steps=args.gradient_accumulation_steps)
         carry = (stack_replicas(params, dp),
                  stack_replicas(init_state(acfg), dp))
         opt_state = stack_replicas(opt.init(params), dp)
@@ -399,8 +402,8 @@ def run_seq_parallel(args):
 
         _pretrain_loop(
             args, logger, step, carry, opt_state,
-            # --batch-size is per data rank, as on every other path
-            args.batch_size * dp,
+            # --batch-size is per data rank per microstep
+            args.batch_size * dp * args.gradient_accumulation_steps,
             # row 0 of the replicas IS the single-module layout
             lambda ps: {"params": jax.tree.map(lambda x: x[0], ps[0]),
                         "model_state": {}})

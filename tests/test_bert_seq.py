@@ -177,3 +177,48 @@ class TestSeqSparseComposition:
         moved = sum(float(jnp.sum((a[0] - b) ** 2)) for a, b in zip(
             jax.tree.leaves(p), jax.tree.leaves(params)))
         assert moved > 0
+
+    def test_accumulation_matches_large_batch_dense(self, cfg, params):
+        """accum_steps=2 on half-batches == one step on the full batch
+        (dense compressor; per-row weighted means make the halves equal-
+        weight when mask counts match, so use uniform masking)."""
+        from oktopk_tpu.collectives.state import init_state
+        from oktopk_tpu.config import OkTopkConfig
+        from oktopk_tpu.optim.sgd import sgd
+        from oktopk_tpu.parallel.bert_seq import (
+            build_seq_sparse_train_step, stack_replicas)
+
+        dp, sp = 2, 4
+        mesh = make_seq_mesh(sp, data_size=dp)
+        n = sum(x.size for x in jax.tree.leaves(params))
+        acfg = OkTopkConfig(n=n, num_workers=dp, density=0.05,
+                            warmup_steps=0, use_pallas=False)
+        opt = sgd(lr=0.1)
+        rng = np.random.RandomState(17)
+        batch = make_batch(rng, cfg.vocab_size)
+        # uniform per-example mask count so half-batch means average
+        # exactly to the full-batch mean
+        mlm = np.full((B, T), -1, np.int32)
+        ids = np.asarray(batch["input_ids"])
+        for b in range(B):
+            cols = rng.choice(T, size=3, replace=False)
+            mlm[b, cols] = ids[b, cols]
+        batch["mlm_labels"] = jnp.asarray(mlm)
+
+        outs = {}
+        for acc in (1, 2):
+            step = build_seq_sparse_train_step(
+                cfg, mesh, opt, acfg, compressor="dense", warmup=False,
+                accum_steps=acc)
+            p2, _, _, loss = step(stack_replicas(params, dp),
+                                  stack_replicas(init_state(acfg), dp),
+                                  stack_replicas(opt.init(params), dp),
+                                  batch)
+            outs[acc] = (p2, float(loss))
+        np.testing.assert_allclose(outs[1][1], outs[2][1], rtol=1e-6)
+        for (pa, a), (_, b) in zip(
+                jax.tree_util.tree_leaves_with_path(outs[1][0]),
+                jax.tree_util.tree_leaves_with_path(outs[2][0])):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), atol=1e-6,
+                err_msg=jax.tree_util.keystr(pa))
