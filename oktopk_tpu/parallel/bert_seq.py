@@ -249,13 +249,17 @@ def build_seq_sparse_train_step(cfg: BertConfig, mesh: Mesh, optimizer,
                 return (jax.tree.map(jnp.add, g_acc, g_i),
                         l_acc + loss_i), None
 
-            # seed the carry with slice 0 so its VMA type matches the
-            # per-slice grads from the start (a zeros-init carry is
-            # invariant and lax.scan rejects the type change)
-            first = jax.tree.map(lambda x: x[0], mb)
-            rest = jax.tree.map(lambda x: x[1:], mb)
-            loss0, g0 = one(params, first)
-            (grads, loss), _ = lax.scan(body, (g0, loss0), rest)
+            # a zeros-init carry is VMA-invariant while the per-slice
+            # grads are varying; pvary_like aligns the types so one scan
+            # covers every slice (peeling slice 0 instead would embed a
+            # second full fwd+bwd in the compiled program)
+            # grads/loss share params' vma ({data}: the loss psums leave
+            # them seq-invariant), so params is the alignment reference
+            from oktopk_tpu.comm.primitives import pvary_like
+            zero = pvary_like(
+                (jax.tree.map(jnp.zeros_like, params), jnp.float32(0.0)),
+                jax.tree.leaves(params)[0])
+            (grads, loss), _ = lax.scan(body, zero, mb)
             grads = jax.tree.map(lambda g: g / accum_steps, grads)
             loss = loss / accum_steps
         else:
