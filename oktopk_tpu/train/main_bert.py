@@ -46,6 +46,10 @@ def parse_args(argv=None):
                         "BERT/runtime.py:842); 1 = pure DP")
     p.add_argument("--num-microbatches", type=int, default=4,
                    help="GPipe microbatches per flush when pipelining")
+    p.add_argument("--remat", action="store_true",
+                   help="rematerialise stage activations in backward "
+                        "(the reference's recompute mode, "
+                        "BERT/runtime.py:546-558)")
     p.add_argument("--seq-shards", type=int, default=1,
                    help="sequence/context parallelism: shard the token "
                         "axis over a seq mesh with ring attention "
@@ -233,14 +237,14 @@ def run_pipeline(args):
         step0 = build_pipeline_sparse_train_step(
             staged, mesh, num_microbatches=args.num_microbatches,
             optimizer=opt, algo_cfg=acfg, compressor=args.compressor,
-            warmup=False)
+            warmup=False, remat=args.remat)
         logger.info("sparse pipeline: compressor=%s density=%g",
                     args.compressor, args.density)
     else:
         opt_states = init_pipeline_opt_state(opt, stack, shared)
         step0 = build_pipeline_train_step(
             staged, mesh, num_microbatches=args.num_microbatches,
-            optimizer=opt)
+            optimizer=opt, remat=args.remat)
 
     global_bs = args.batch_size * dp * args.num_microbatches
     data_iter, meta = make_dataset("wikipedia", args.model, global_bs,
