@@ -247,6 +247,10 @@ def build_sparse_grad_step(
         else:
             new_momentum = state.local_momentum
         grad_norm = jnp.sqrt(sum(jnp.sum(r ** 2) for r in results))
+        # nonfinite reduced-gradient elements (the reference warns when
+        # the gradient sparsity goes NaN, VGG/dl_trainer.py:608-609; a
+        # count in the metrics makes the blow-up step identifiable)
+        grad_nonfinite = sum(jnp.sum(~jnp.isfinite(r)) for r in results)
         eps = (jnp.sqrt(eps_num) / (jnp.sqrt(eps_den) + 1e-12)
                if profile_norm else None)
 
@@ -258,6 +262,7 @@ def build_sparse_grad_step(
         metrics = {
             "loss": lax.pmean(loss, axis_name),
             "grad_norm": grad_norm,
+            "grad_nonfinite": grad_nonfinite,
             "comm_volume": vol,
             "local_k": lk,
             "global_k": gk,

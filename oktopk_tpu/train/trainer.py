@@ -216,6 +216,8 @@ class Trainer:
         metrics = {}
         pending = []  # (step, device-metrics) — flushed on the log cadence
         # so the writer never forces a per-step device sync
+        nf_window = []  # per-step nonfinite-grad counters (device scalars;
+        # summed host-side only on the log cadence)
 
         def flush_pending():
             for s, dm in pending:
@@ -245,6 +247,8 @@ class Trainer:
                 metrics = self.train_step(batch)
             if metric_writer is not None:
                 pending.append((step, metrics))
+            if "grad_nonfinite" in metrics:
+                nf_window.append(metrics["grad_nonfinite"])
             if (i + 1) % log_every == 0:
                 if metric_writer is not None:
                     flush_pending()
@@ -256,6 +260,15 @@ class Trainer:
                         "iter %d loss %.4f vol %.0f %.3fs/it", step,
                         float(metrics["loss"]),
                         float(metrics["comm_volume"]), dt)
+                    nf = sum(float(x) for x in nf_window)
+                    if nf:
+                        # the reference warns on NaN gradient sparsity
+                        # (VGG/dl_trainer.py:608-609); the whole window is
+                        # summed so a mid-window blow-up cannot hide
+                        logger.warning(
+                            "window ending iter %d: %d nonfinite gradient "
+                            "elements", step, int(nf))
+                    nf_window.clear()
                     t0 = time.time()
             if timers is not None and logger is not None:
                 timers.maybe_log(step, logger)

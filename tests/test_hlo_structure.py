@@ -1,0 +1,74 @@
+"""Structural guards on the compiled oktopk program.
+
+The volume metric is analytic; this pins the COMPILED program to the
+claimed communication pattern so a regression that silently widens a
+collective (or adds a dense one) fails even if the analytic counters
+still look right. The sparse allreduce must never move an n-length
+buffer: its collectives operate on fixed-capacity [P, cap]-scale
+operands only (SURVEY.md §5.8 mapping)."""
+
+import re
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from oktopk_tpu.collectives.api import batched_init_state, \
+    build_allreduce_step
+from oktopk_tpu.config import OkTopkConfig
+
+N = 1 << 17
+P = 8
+
+
+def _collective_shapes(hlo_text, op):
+    """Max element count on every `op` line in the HLO (async -start
+    forms and tuple result types included; the guard cares about ANY
+    n-scale operand, so take the largest shape on the line — re.findall
+    returns '' for unmatched alternation groups, hence `if g`)."""
+    out = []
+    for m in re.finditer(rf"= .*? {op}(?:-start)?\(", hlo_text):
+        start = hlo_text.rfind("\n", 0, m.start()) + 1
+        line = hlo_text[start:hlo_text.index("\n", m.start())]
+        best = 0
+        for _, dims in re.findall(r"(f32|bf16|s32|u32|pred|s8)"
+                                  r"\[([\d,]*)\]", line):
+            elems = 1                     # scalar [] counts as 1
+            for d in dims.split(","):
+                if d:
+                    elems *= int(d)
+            best = max(best, elems)
+        if best:
+            out.append(best)
+    return out
+
+
+class TestOkTopkCompiledStructure:
+    def test_no_full_length_collectives(self, mesh8):
+        cfg = OkTopkConfig(n=N, num_workers=P, density=0.01,
+                           warmup_steps=0, use_pallas=False)
+        step = build_allreduce_step("oktopk", cfg, mesh8, warmup=False)
+        state = batched_init_state(cfg)
+        g = jnp.zeros((P, N), jnp.float32)
+        hlo = step.lower(g, state).compile().as_text()
+
+        sizes = []
+        for op in ("all-gather", "all-to-all", "all-reduce"):
+            sizes += _collective_shapes(hlo, op)
+        assert sizes, "no collectives found — parsing broke?"
+        # every collective operand stays capacity-scale: the largest
+        # gather is P * cap_exact-ish, far below the n-length dense path
+        assert max(sizes) < N, (
+            f"an n-scale collective appeared: {sorted(sizes)[-4:]} vs n={N}")
+
+    def test_dense_does_use_full_length(self, mesh8):
+        """Sanity for the parser: the dense algorithm MUST show an
+        n-length all-reduce."""
+        cfg = OkTopkConfig(n=N, num_workers=P, density=1.0,
+                           warmup_steps=0, use_pallas=False)
+        step = build_allreduce_step("dense", cfg, mesh8, warmup=False)
+        state = batched_init_state(cfg)
+        g = jnp.zeros((P, N), jnp.float32)
+        hlo = step.lower(g, state).compile().as_text()
+        sizes = _collective_shapes(hlo, "all-reduce")
+        assert sizes and max(sizes) >= N, sizes
