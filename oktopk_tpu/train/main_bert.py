@@ -208,6 +208,8 @@ def run_pipeline(args):
 
     params = staged.init(jax.random.PRNGKey(args.seed), 2,
                          args.max_seq_length)
+    params = _maybe_warm_start(
+        args, logger, {"params": params, "model_state": {}})["params"]
     stack, shared = staged.split(params)
     opt = bert_adam(lr=args.lr, warmup=args.warmup_proportion,
                     t_total=args.num_minibatches)
@@ -297,6 +299,44 @@ def _bert_algo_cfg(args, **kw):
         wire_dtype=args.wire_dtype, **kw)
 
 
+def _maybe_warm_start(args, logger, template):
+    """Params-only warm start for the extension paths: restore the saved
+    payload shape into ``template`` and return it. Optimizer / sparse
+    state start fresh (these paths checkpoint the canonical single-module
+    or moe payload, not the full replica carry); the DP path keeps its
+    full-state resume."""
+    if not args.resume:
+        return template
+    import jax
+    import numpy as np
+
+    from oktopk_tpu.train.checkpoint import restore_checkpoint
+    restored, rstep = restore_checkpoint(args.resume, template)
+    # restore_checkpoint keeps template leaves for missing payload keys,
+    # so a layout mismatch (e.g. a DP {"params": ...} checkpoint fed to
+    # the moe path) would silently train from random init; and flax
+    # accepts wrong-shaped leaves silently. Validate both.
+    changed = False
+    for (pa, a), (_, b) in zip(
+            jax.tree_util.tree_leaves_with_path(template),
+            jax.tree_util.tree_leaves_with_path(restored)):
+        if np.shape(a) != np.shape(b):
+            raise SystemExit(
+                f"--resume leaf {jax.tree_util.keystr(pa)} has shape "
+                f"{np.shape(b)} but this model expects {np.shape(a)} "
+                f"(wrong --model for the checkpoint?)")
+        if not changed and not np.array_equal(np.asarray(a),
+                                              np.asarray(b)):
+            changed = True
+    if not changed:
+        raise SystemExit(
+            f"--resume {args.resume} restored nothing — its payload "
+            f"layout does not match this path's checkpoint format")
+    logger.info("warm-started from %s (saved at step %d; optimizer and "
+                "sparse state start fresh)", args.resume, rstep)
+    return restored
+
+
 def _pretrain_loop(args, logger, step_fn, params, opt_state, global_bs,
                    checkpoint_payload):
     """Shared dataset/loop/log/checkpoint tail of the whole-model parallel
@@ -378,6 +418,8 @@ def run_seq_parallel(args):
     params = BertForPreTraining(cfg).init(
         {"params": rng, "dropout": rng}, ex, ex, jnp.ones_like(ex),
         train=False)["params"]
+    params = _maybe_warm_start(
+        args, logger, {"params": params, "model_state": {}})["params"]
     opt = bert_adam(lr=args.lr, warmup=args.warmup_proportion,
                     t_total=args.num_minibatches)
 
@@ -470,6 +512,12 @@ def run_expert_parallel(args):
     # capacity bound then drops most of the batch (bert_moe.py docstring)
     params = experts_from_dense(dense_params, E, gate_scale=0.02,
                                 seed=args.seed)
+    restored = _maybe_warm_start(
+        args, logger, {"moe_params": {"layers": params[0],
+                                      "shared": params[1]},
+                       "model_state": {}})
+    params = (restored["moe_params"]["layers"],
+              restored["moe_params"]["shared"])
     opt = bert_adam(lr=args.lr, warmup=args.warmup_proportion,
                     t_total=args.num_minibatches)
     # --batch-size is per-worker (as in the DP/pipeline paths); the MoE
