@@ -23,46 +23,69 @@ from jax import lax
 _WAYS = 8  # brackets per pass; each memory pass narrows log2(_WAYS) bits
 
 
+_LOG_RANGE_BITS = 64.0   # dynamic range below max|x| the bracket covers
+
+
 def k2threshold_bisect(x_abs: jnp.ndarray, k: int, iters: int = 30):
-    """Sort-free k-th-largest estimate to ``iters`` bits of precision.
+    """Sort-free k-th-largest estimate via multi-way bisection IN LOG
+    SPACE.
 
-    Multi-way bisection: each trip splits the bracket [lo, hi) into
-    ``_WAYS`` sub-intervals and counts all boundaries in ONE pass over the
-    data (per-element ``searchsorted`` into the 7 interior cut points +
-    bincount), then keeps the sub-interval where count(|x| >= t) crosses k.
-    One memory pass narrows 3 bits instead of the 1 bit of classic
-    bisection, so 30-bit precision costs 10 passes instead of 30 — the hot
-    selection path is HBM-bandwidth-bound (SURVEY.md §7.3.5).
+    Each trip splits the bracket into ``_WAYS`` geometric sub-intervals
+    and counts all boundaries in ONE pass over the data (per-element
+    ``searchsorted`` into the 7 interior cut points + a fused streaming
+    reduce), then keeps the sub-interval where count(|x| >= t) crosses k.
+    One memory pass narrows the bracket 8x — the hot selection path is
+    HBM-bandwidth-bound (SURVEY.md §7.3.5).
 
-    Returns the bracket's lower edge (count(>= lo) >= k), matching
-    ``k2threshold``'s inclusivity. The final bracket is max|x|/2^iters wide
-    — below float32 resolution for the default 30.
+    Why log space: a LINEAR bracket [0, max] resolves only max/2^iters.
+    Under error feedback at convergence the k-th |value| sits many orders
+    of magnitude below a few large residuals (> 30 bits of dynamic
+    range), so the linear form returned exactly 0 — and zero is an
+    ABSORBING state for the multiplicative threshold controller
+    (0 x corr == 0 forever): observed as local_k == n, saturated
+    capacity buffers, and an eventual loss blow-up on the convergence
+    harness. Geometric cuts resolve the full f32 range and the returned
+    lower edge is always > 0 (max|x| * 2^-64 at worst) so the controller
+    can always recover.
+
+    Returns the bracket's lower edge with count(>= lo) >= k whenever at
+    least k elements lie within 2^-64 of max|x|. DELIBERATE divergence
+    from the "sort" method when fewer do (sparse / dead accumulators):
+    "sort" returns 0 and selects everything including zeros; this returns
+    the positive bracket floor and selects only the live elements —
+    strictly less wire traffic, and never the absorbing zero. The result
+    is clamped to the smallest normal f32 exponent so it cannot underflow
+    back to 0 (TPU flushes subnormals anyway); exactly 0 only when
+    ``x_abs`` is all zero.
     """
     hi0 = jnp.max(x_abs)
     flat = x_abs.reshape(-1)
     bits_per_pass = max(1, int(_WAYS).bit_length() - 1)  # log2(_WAYS)
     passes = -(-iters // bits_per_pass)
 
+    e_hi = jnp.log2(jnp.maximum(hi0, jnp.float32(1e-38))) + 1e-3
+    e_lo = e_hi - jnp.float32(_LOG_RANGE_BITS)
+
     def body(_, carry):
-        lo, hi = carry
-        # interior cut points t_1 < ... < t_{W-1} of [lo, hi)
-        frac = jnp.arange(1, _WAYS, dtype=x_abs.dtype) / _WAYS
-        cuts = lo + (hi - lo) * frac
-        # ONE data pass: per-element bucket id (3 register compares via
-        # searchsorted), then counts[j] = #elements above cut j as a fused
-        # streaming reduce — no scatter, nothing materialised at [n, W]
+        lo, hi = carry                              # log2 exponents
+        frac = jnp.arange(1, _WAYS, dtype=jnp.float32) / _WAYS
+        cuts_e = lo + (hi - lo) * frac
+        cuts = jnp.exp2(cuts_e).astype(x_abs.dtype)
         b = jnp.searchsorted(cuts, flat, side="left").astype(jnp.int32)
         counts = jnp.sum(
             b[:, None] >= jnp.arange(_WAYS, dtype=jnp.int32)[None, :],
             axis=0)
-        # counts[0] = n (>= k always); counts[j>=1] = #{x > cuts[j-1]}.
-        # Keep the bracket whose lower edge still has >= k above it.
+        # counts[0] = n (>= k always); counts[j>=1] = #{x > cuts[j-1]}
+        # (side="left" makes the count strict). Keep the bracket whose
+        # lower edge still has >= k above it.
         enough = counts >= k
         j = jnp.max(jnp.where(enough, jnp.arange(_WAYS), 0))
-        edges = jnp.concatenate([lo[None], cuts, hi[None]])
+        edges = jnp.concatenate([lo[None], cuts_e, hi[None]])
         return edges[j], edges[j + 1]
 
-    lo, hi = lax.fori_loop(
-        0, passes, body,
-        (jnp.zeros_like(hi0), hi0 * (1 + 1e-6) + 1e-30))
-    return lo
+    lo, hi = lax.fori_loop(0, passes, body, (e_lo, e_hi))
+    # clamp to the min normal exponent: exp2(e_hi - 64) underflows to an
+    # exact 0 for max|x| below ~2^-85, which would re-enter the absorbing
+    # zero state this function exists to prevent
+    t = jnp.exp2(jnp.maximum(lo, jnp.float32(-126.0))).astype(x_abs.dtype)
+    return jnp.where(hi0 > 0, t, jnp.zeros_like(t))

@@ -22,6 +22,8 @@ import os
 import sys
 import time
 
+import numpy as np
+
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
@@ -52,6 +54,12 @@ def run_one(model: str, compressor: str, steps: int, mesh, density: float,
             if (i + 1) % log_every == 0 or i == 0:
                 rec = {"step": i + 1, "loss": float(m["loss"]),
                        "comm_volume": float(m["comm_volume"])}
+                # selection/stability observability (threshold-controller
+                # excursions and nonfinite gradients show up here first)
+                for k in ("local_k", "global_k", "grad_norm",
+                          "grad_nonfinite"):
+                    if k in m:
+                        rec[k] = float(np.asarray(m[k]).mean())
                 f.write(json.dumps(rec) + "\n")
                 f.flush()
     print(f"[convergence] {model}/{compressor}: final loss "

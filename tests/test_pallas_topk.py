@@ -26,3 +26,51 @@ class TestBisect:
         assert int(jnp.sum(x >= t)) == 256      # selects everything
         t1 = k2threshold_bisect(x, 1)
         assert int(jnp.sum(x >= t1)) >= 1
+
+
+class TestWideDynamicRange:
+    def test_threshold_resolves_tiny_kth_value(self):
+        """Error feedback at convergence: a few huge residuals over many
+        tiny gradients (> 30 bits of dynamic range). The linear-space
+        bisection returned exactly 0 here — an absorbing state for the
+        multiplicative threshold controller (observed as local_k == n and
+        a loss blow-up on the convergence harness); log-space cuts must
+        resolve the true k-th value."""
+        from oktopk_tpu.ops.pallas_topk import k2threshold_bisect
+
+        rng = np.random.RandomState(0)
+        x = np.abs(rng.randn(1 << 16).astype(np.float32)) * 1e-9
+        x[:64] = np.abs(rng.randn(64)).astype(np.float32) * 100.0
+        k = 1024
+        t = float(k2threshold_bisect(jnp.asarray(x), k))
+        kth = float(np.sort(x)[::-1][k - 1])
+        assert t > 0.0, "threshold collapsed to the absorbing zero"
+        count = int(np.sum(x >= t))
+        assert k <= count <= int(1.01 * k) + 8, (count, k)
+        assert abs(t - kth) <= 1e-3 * kth + 1e-12, (t, kth)
+
+    def test_all_zero_input_gives_zero(self):
+        from oktopk_tpu.ops.pallas_topk import k2threshold_bisect
+        t = float(k2threshold_bisect(jnp.zeros(4096, jnp.float32), 16))
+        assert t == 0.0
+
+    def test_tiny_magnitude_input_never_returns_zero(self):
+        """max|x| ~ 1e-30: exp2 of the bracket floor would underflow to an
+        exact 0 without the min-normal clamp, re-entering the absorbing
+        zero state."""
+        from oktopk_tpu.ops.pallas_topk import k2threshold_bisect
+        rng = np.random.RandomState(1)
+        x = np.abs(rng.randn(4096).astype(np.float32)) * 1e-30
+        t = float(k2threshold_bisect(jnp.asarray(x), 4096))
+        assert t > 0.0
+
+    def test_fewer_live_than_k_selects_only_live(self):
+        """Documented divergence from the 'sort' method: with fewer than
+        k elements within 2^-64 of max, only the live ones are selected
+        (never zeros, never the absorbing 0 threshold)."""
+        from oktopk_tpu.ops.pallas_topk import k2threshold_bisect
+        x = np.zeros(4096, np.float32)
+        x[:10] = 1.0
+        t = float(k2threshold_bisect(jnp.asarray(x), 16))
+        assert t > 0.0
+        assert int(np.sum(x >= t)) == 10
