@@ -226,20 +226,7 @@ def main():
     steps = {}
     deadline = int(os.environ.get("OKTOPK_BENCH_STEP_DEADLINE", "900"))
 
-    def _relay_listening(port=8113):
-        """The TPU tunnel's local relay (remote-compile endpoint). When
-        nothing listens there the device dial blocks forever; probing the
-        socket first keeps a dead-tunnel bench run short."""
-        import socket
-        s = socket.socket()
-        s.settimeout(1.0)
-        try:
-            s.connect(("127.0.0.1", port))
-            return True
-        except OSError:
-            return False
-        finally:
-            s.close()
+    from oktopk_tpu.utils.tunnel import relay_expected, relay_listening
 
     attempts = 2
     # Only short-circuit when this environment actually reaches the
@@ -247,9 +234,7 @@ def main():
     # present) AND nothing listens at it — a CPU-only box or a directly
     # attached TPU must keep the full policy. An explicitly set
     # OKTOPK_BENCH_STEP_DEADLINE is always honored.
-    relay_expected = bool(os.environ.get("PALLAS_AXON_POOL_IPS"))
-    relay_port = int(os.environ.get("OKTOPK_RELAY_PORT", "8113"))
-    if (relay_expected and not _relay_listening(relay_port)
+    if (relay_expected() and not relay_listening()
             and "OKTOPK_BENCH_STEP_DEADLINE" not in os.environ):
         print("[bench] tunnel relay not listening; single short probe "
               "attempt only", file=sys.stderr)

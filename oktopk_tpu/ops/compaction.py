@@ -129,7 +129,10 @@ def _compact_tile(qs, sel, capb):
     [4,128] x [capb,128]^T matmuls replace the single [4,BLK] x [BLK,capb]
     one; slots are distinct across rows so the accumulation is collision-
     free and exact."""
-    jio = jax.lax.broadcasted_iota(jnp.float32, (capb, BLK_COLS), 0)
+    # i32 iota/compare: tpu.iota verifies only integer result types (a
+    # float iota fails Mosaic verification on the real chip; the
+    # interpreter accepts it)
+    jio = jax.lax.broadcasted_iota(jnp.int32, (capb, BLK_COLS), 0)
     acc = jnp.zeros((4, capb), jnp.float32)
     for r in range(BLK_ROWS):
         selr = jax.lax.slice(sel, (r, 0), (r + 1, BLK_COLS))   # [1, 128]
@@ -173,7 +176,7 @@ def _compact_kernel(capb, t_ref, r_ref, x_ref, vh_ref, vl_ref, ih_ref,
     pos, _ = _block_prefix(m)
 
     kept = mask & (pos < capb)
-    sel = jnp.where(kept, pos, capb).astype(jnp.float32)  # capb = dropped
+    sel = jnp.where(kept, pos, capb)                      # capb = dropped
     stored = jnp.sum(kept.astype(jnp.int32))
 
     stage_ref[:] = _compact_tile(_quantity_rows(x, gidx, kept), sel, capb)
@@ -317,7 +320,7 @@ def _pack_regions_kernel(num_regions, capb, t_ref, b_ref, x_ref,
             m = mask_r.astype(jnp.int32)
             pos, _ = _block_prefix(m)
             kept = mask_r & (pos < capb)
-            sel = jnp.where(kept, pos, capb).astype(jnp.float32)
+            sel = jnp.where(kept, pos, capb)
             stored = jnp.sum(kept.astype(jnp.int32))
             stage_ref[:] = _compact_tile(_quantity_rows(x, gidx, kept),
                                          sel, capb)

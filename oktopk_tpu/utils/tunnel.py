@@ -1,0 +1,40 @@
+"""Device-tunnel liveness probe.
+
+When the TPU is reached through the axon tunnel (the site plugin's
+``PALLAS_AXON_POOL_IPS`` env), a dead local relay makes ``jax.devices()``
+block forever inside C — no exception, signal handlers never run. The only
+safe pattern is to probe the relay socket *before* any backend use (and to
+put hard deadlines on child processes that do touch the backend). Shared by
+``bench.py`` and the opt-in hardware tests.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+
+DEFAULT_RELAY_PORT = 8113
+
+
+def relay_port() -> int:
+    return int(os.environ.get("OKTOPK_RELAY_PORT", str(DEFAULT_RELAY_PORT)))
+
+
+def relay_expected() -> bool:
+    """True when this environment reaches the accelerator through the
+    tunnel relay at all (a CPU-only box or a directly attached TPU keeps
+    its normal path and needs no probe)."""
+    return bool(os.environ.get("PALLAS_AXON_POOL_IPS"))
+
+
+def relay_listening(port: int | None = None, timeout: float = 1.0) -> bool:
+    """True when something accepts on the tunnel relay's local port."""
+    s = socket.socket()
+    s.settimeout(timeout)
+    try:
+        s.connect(("127.0.0.1", relay_port() if port is None else port))
+        return True
+    except OSError:
+        return False
+    finally:
+        s.close()

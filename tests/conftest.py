@@ -15,6 +15,10 @@ import os
 _flag = "--xla_force_host_platform_device_count=8"
 if _flag not in os.environ.get("XLA_FLAGS", ""):
     os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") + " " + _flag).strip()
+# Preserve the session's platform choice for the opt-in hardware tests
+# (tests/test_tpu_hw.py) before clobbering it for the CPU suite.
+os.environ.setdefault("OKTOPK_ORIG_JAX_PLATFORMS",
+                      os.environ.get("JAX_PLATFORMS", ""))
 os.environ["JAX_PLATFORMS"] = "cpu"
 
 import jax  # noqa: E402

@@ -1,0 +1,115 @@
+"""On-hardware Pallas kernel checks (skipped on the CPU test mesh).
+
+The test suite runs on a virtual CPU mesh (conftest.py forces
+``JAX_PLATFORMS=cpu``), where the compaction kernels run in the Pallas
+interpreter. The interpreter accepts constructs Mosaic's real-chip lowering
+rejects — three were caught only on hardware so far (scalar fancy-indexing
+-> dynamic_slice, cross-lane shape casts, float tpu.iota; see
+ops/compaction.py docstrings). This module re-runs the kernel parity checks
+compiled for the real chip, and is the regression net for that class of bug.
+
+Run with the hardware backend selected, e.g.:
+    OKTOPK_TPU_HW=1 JAX_PLATFORMS=axon python -m pytest tests/test_tpu_hw.py
+
+It deliberately keys off an explicit opt-in env var rather than devices():
+importing jax with the tunnel env but a dead relay blocks forever, which
+must never hang the default CPU suite.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+if os.environ.get("OKTOPK_TPU_HW", "0") != "1":
+    pytest.skip("OKTOPK_TPU_HW=1 not set (hardware-only tests)",
+                allow_module_level=True)
+
+
+from oktopk_tpu.utils.tunnel import relay_expected, relay_listening  # noqa: E402
+
+if relay_expected() and not relay_listening():
+    pytest.skip("TPU tunnel relay not listening (dead tunnel)",
+                allow_module_level=True)
+
+import jax  # noqa: E402
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _hw_platform():
+    """Restore the session's platform choice for this module's tests.
+
+    conftest.py clobbers JAX_PLATFORMS to "cpu" for the suite, saving the
+    original in OKTOPK_ORIG_JAX_PLATFORMS. The restore must NOT happen at
+    import time — pytest imports every module during collection, so a
+    full-suite run would flip all the CPU tests onto the hardware backend.
+    As a fixture it runs only when this module's tests actually start: run
+    alone (the documented usage) no backend exists yet and the update takes
+    effect; in a full-suite run an earlier test already initialized the CPU
+    backend and the hardware device simply isn't visible, so ``tpu_dev``
+    skips. An empty original means the platform was auto-detected — reset
+    to None to re-enable detection (a directly attached TPU with no env
+    var set). Teardown pins "cpu" back for any later modules.
+    """
+    orig = os.environ.get("OKTOPK_ORIG_JAX_PLATFORMS", "")
+    if orig != "cpu":
+        jax.config.update("jax_platforms", orig or None)
+    yield
+    if orig != "cpu":
+        jax.config.update("jax_platforms", "cpu")
+
+import jax.numpy as jnp  # noqa: E402
+
+from oktopk_tpu.ops.compaction import (  # noqa: E402
+    mesh_supports_pallas, pack_by_region_pallas, select_by_threshold_pallas)
+from oktopk_tpu.ops.select import pack_by_region, select_by_threshold  # noqa: E402
+
+
+@pytest.fixture(scope="module")
+def tpu_dev():
+    devs = [d for d in jax.devices() if d.platform in ("tpu", "axon")]
+    if not devs:
+        pytest.skip("no TPU device visible")
+    return devs[0]
+
+
+def test_select_parity_on_chip(tpu_dev):
+    rng = np.random.RandomState(0)
+    n = 1 << 18
+    x = rng.randn(n).astype(np.float32)
+    cap = 4096
+    with jax.default_device(tpu_dev):
+        gv, gi, gc = select_by_threshold_pallas(jnp.asarray(x), 2.0, cap,
+                                                interpret=False)
+        gv, gi, gc = map(np.asarray, (gv, gi, gc))
+    wv, wi, wc = map(np.asarray,
+                     select_by_threshold(jnp.asarray(x), 2.0, cap))
+    assert gc == wc
+    np.testing.assert_array_equal(gi, wi)
+    np.testing.assert_array_equal(gv, wv)
+
+
+def test_pack_by_region_parity_on_chip(tpu_dev):
+    rng = np.random.RandomState(1)
+    n = 1 << 18
+    x = rng.randn(n).astype(np.float32)
+    bounds = np.array([0, n // 3, n // 2, n], np.int32)
+    cap = 2048
+    with jax.default_device(tpu_dev):
+        gv, gi, gc = pack_by_region_pallas(jnp.asarray(x), 1.5,
+                                           jnp.asarray(bounds), 3, cap,
+                                           interpret=False)
+        gv, gi, gc = map(np.asarray, (gv, gi, gc))
+    wv, wi, wc = map(np.asarray,
+                     pack_by_region(jnp.asarray(x),
+                                    jnp.abs(jnp.asarray(x)) >= 1.5,
+                                    jnp.asarray(bounds), 3, cap))
+    np.testing.assert_array_equal(gc, wc)
+    np.testing.assert_array_equal(gi, wi)
+    np.testing.assert_array_equal(gv, wv)
+
+
+def test_mesh_supports_pallas_on_hw(tpu_dev):
+    from oktopk_tpu.comm.mesh import get_mesh
+    mesh = get_mesh((1,), ("data",), devices=[tpu_dev])
+    assert mesh_supports_pallas(mesh)
