@@ -58,6 +58,31 @@ def synthetic_iterator(dnn: str, batch_size: int, seed: int = 0,
         yield synthetic_batch(dnn, batch_size, rng, seq_len)
 
 
+def finite_pool_iterator(dnn: str, batch_size: int, num_examples: int = 256,
+                         seed: int = 0,
+                         seq_len: int = None) -> Iterator[Dict[str, np.ndarray]]:
+    """Finite synthetic dataset, shuffled and recycled forever.
+
+    The convergence analogue of ``teacher_iterator`` for the token
+    workloads (BERT/LSTM/CTC), where a linear teacher over pixels doesn't
+    apply: a FINITE pool of examples is memorizable, so the loss trend is
+    a real optimization signal and dense-vs-sparse gaps on the same pool
+    measure the compression (fresh random tokens every step would be
+    unfittable in expectation). Used by scripts/convergence.py for
+    bert_*/lstm convergence evidence."""
+    if batch_size > num_examples:
+        raise ValueError(f"batch_size {batch_size} > pool size "
+                         f"{num_examples}: the cycle would never yield")
+    rng = np.random.RandomState(seed)
+    pool = synthetic_batch(dnn, num_examples, rng, seq_len)
+    order_rng = np.random.RandomState(seed + 1)
+    while True:
+        order = order_rng.permutation(num_examples)
+        for i in range(0, num_examples - batch_size + 1, batch_size):
+            sel = order[i:i + batch_size]
+            yield {k: v[sel] for k, v in pool.items()}
+
+
 def teacher_iterator(dnn: str, batch_size: int, num_examples: int = 512,
                      seed: int = 0) -> Iterator[Dict[str, np.ndarray]]:
     """Finite image dataset with *learnable* labels from a fixed random

@@ -32,7 +32,8 @@ def run_one(model: str, compressor: str, steps: int, mesh, density: float,
             batch_size: int = 8):
 
     from oktopk_tpu.config import TrainConfig
-    from oktopk_tpu.data.synthetic import teacher_iterator
+    from oktopk_tpu.data.synthetic import (finite_pool_iterator,
+                                           teacher_iterator)
     from oktopk_tpu.train.trainer import Trainer
 
     cfg = TrainConfig(dnn=model, dataset="synthetic-teacher",
@@ -40,10 +41,19 @@ def run_one(model: str, compressor: str, steps: int, mesh, density: float,
                       density=density)
     trainer = Trainer(cfg, mesh=mesh, warmup=False)
     P = trainer.cfg.num_workers
-    it = teacher_iterator(model, batch_size * P, seed=7)
+    # image workloads get teacher labels; token workloads (bert/lstm/ctc)
+    # memorize a finite pool — both give a learnable, compressor-agnostic
+    # objective (see the iterator docstrings)
+    if model.startswith(("bert", "lstm")):
+        it = finite_pool_iterator(model, batch_size * P, seed=7)
+    else:
+        it = teacher_iterator(model, batch_size * P, seed=7)
 
     path = os.path.join(out_dir, f"{model}_{compressor}.jsonl")
     t0 = time.time()
+    # fixed pool batch for periodic eval: train-set accuracy/ppl, the
+    # metric the reference's logs carry (VGG/dl_trainer.py:606-616)
+    eval_batch = next(it)
     with open(path, "w") as f:
         header = {"model": model, "compressor": compressor, "steps": steps,
                   "workers": P, "density": density, "lr": lr,
@@ -51,9 +61,13 @@ def run_one(model: str, compressor: str, steps: int, mesh, density: float,
         f.write(json.dumps(header) + "\n")
         for i in range(steps):
             m = trainer.train_step(next(it))
-            if (i + 1) % log_every == 0 or i == 0:
+            if (i + 1) % log_every == 0 or i == 0 or i + 1 == steps:
                 rec = {"step": i + 1, "loss": float(m["loss"]),
                        "comm_volume": float(m["comm_volume"])}
+                if (i + 1) % (5 * log_every) == 0 or i + 1 == steps:
+                    em = trainer.eval_step(eval_batch)
+                    rec.update({f"eval_{k}": float(np.asarray(v))
+                                for k, v in em.items()})
                 # selection/stability observability (threshold-controller
                 # excursions and nonfinite gradients show up here first)
                 for k in ("local_k", "global_k", "grad_norm",

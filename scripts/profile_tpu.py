@@ -40,7 +40,10 @@ def bench_fn(fn, *args, iters=10, warmup=2):
 def main():
     dev = jax.devices()[0]
     print(f"device: {dev}", flush=True)
-    n = 14_700_000
+    n = 14_700_000          # ~ VGG16 flat-gradient size
+    for a in sys.argv[1:]:
+        if a.startswith("--n="):
+            n = int(a.split("=", 1)[1])
     k = int(0.02 * n)
     rng = np.random.RandomState(0)
     x = jnp.asarray(rng.randn(n).astype(np.float32))
@@ -67,6 +70,24 @@ def main():
     f_sel = jax.jit(lambda v: select_by_threshold(v, t, capg))
     print(f"select_by_threshold: {bench_fn(f_sel, x):.1f} ms", flush=True)
 
+    # the Pallas fast paths of the same two ops (the kernels bench.py's
+    # oktopk probe auto-enables on TPU) — the portable-vs-kernel delta IS
+    # the selection-hot-path story
+    from oktopk_tpu.ops.compaction import (pack_by_region_pallas,
+                                           select_by_threshold_pallas)
+    try:
+        f_selp = jax.jit(
+            lambda v: select_by_threshold_pallas(v, t, capg, interpret=False))
+        print(f"select_by_threshold_pallas: {bench_fn(f_selp, x):.1f} ms",
+              flush=True)
+        f_packp = jax.jit(
+            lambda v: pack_by_region_pallas(v, t, bounds, P, cap,
+                                            interpret=False))
+        print(f"pack_by_region_pallas: {bench_fn(f_packp, x):.1f} ms",
+              flush=True)
+    except Exception as e:
+        print(f"pallas kernels failed: {e!r}"[:400], flush=True)
+
     # count only
     f_cnt = jax.jit(lambda a: jnp.sum(a >= t))
     print(f"plain count: {bench_fn(f_cnt, xa):.2f} ms", flush=True)
@@ -80,13 +101,20 @@ def main():
     from oktopk_tpu.train.trainer import Trainer
 
     mesh = get_mesh((1,), ("data",), devices=[dev])
-    for comp in ("dense", "oktopk"):
-        cfg = TrainConfig(dnn="vgg16", dataset="cifar10", batch_size=16,
+    # bs16 = the reference's own per-worker batch (tunnel round trip
+    # dominates there); bs256 amortizes the per-step host round trip and
+    # shows the chip's actual images/s headroom
+    for comp, dt_, bs in (("dense", "float32", 16),
+                          ("oktopk", "float32", 16),
+                          ("dense", "float32", 256),
+                          ("dense", "bfloat16", 256),
+                          ("oktopk", "float32", 256)):
+        cfg = TrainConfig(dnn="vgg16", dataset="cifar10", batch_size=bs,
                           lr=0.1, compressor=comp, density=0.02,
-                          num_workers=1)
+                          num_workers=1, compute_dtype=dt_)
         trainer = Trainer(cfg, mesh=mesh, warmup=False)
         batch = jax.device_put(
-            synthetic_batch("vgg16", 16, np.random.RandomState(0)))
+            synthetic_batch("vgg16", bs, np.random.RandomState(0)))
         m = trainer.train_step(batch)
         _sync(m["loss"])
         t0 = time.perf_counter()
@@ -94,7 +122,8 @@ def main():
             m = trainer.train_step(batch)
         _sync(m["loss"])
         dt = (time.perf_counter() - t0) / 10
-        print(f"vgg16 {comp} step: {dt*1e3:.1f} ms", flush=True)
+        print(f"vgg16 {comp}/{dt_} bs{bs} step: {dt*1e3:.1f} ms "
+              f"({bs/dt:.0f} images/s/chip)", flush=True)
 
 
 if __name__ == "__main__":

@@ -183,3 +183,45 @@ class TestNewZooModels:
         y = model.apply(v, x, train=False)
         assert y.shape == (2, 10)
         assert np.all(np.isfinite(np.asarray(y)))
+
+
+class TestFinitePoolIterator:
+    """finite_pool_iterator: the token-workload analogue of
+    teacher_iterator used by scripts/convergence.py."""
+
+    def test_bert_pool_recycles_same_examples(self):
+        from oktopk_tpu.data.synthetic import finite_pool_iterator
+        it = finite_pool_iterator("bert_tiny", 16, num_examples=32, seed=3)
+        first_epoch = [next(it) for _ in range(2)]   # 32/16 = 2 batches
+        second_epoch = [next(it) for _ in range(2)]
+        pool_ids = np.sort(np.concatenate(
+            [b["input_ids"][:, 0] for b in first_epoch]))
+        pool_ids2 = np.sort(np.concatenate(
+            [b["input_ids"][:, 0] for b in second_epoch]))
+        # same finite pool every epoch (memorizable), new shuffle order
+        np.testing.assert_array_equal(pool_ids, pool_ids2)
+        for b in first_epoch:
+            assert set(b) == {"input_ids", "token_type_ids",
+                              "attention_mask", "mlm_labels", "nsp_labels"}
+            assert b["input_ids"].shape == (16, 32)
+
+    def test_lstm_pool_shapes(self):
+        from oktopk_tpu.data.synthetic import finite_pool_iterator
+        it = finite_pool_iterator("lstm", 8, num_examples=16, seed=0)
+        b = next(it)
+        assert b["tokens"].shape == (8, 35)
+        assert b["targets"].shape == (8, 35)
+
+    def test_deterministic_across_constructions(self):
+        from oktopk_tpu.data.synthetic import finite_pool_iterator
+        a = next(finite_pool_iterator("bert_tiny", 8, num_examples=16,
+                                      seed=11))
+        b = next(finite_pool_iterator("bert_tiny", 8, num_examples=16,
+                                      seed=11))
+        np.testing.assert_array_equal(a["input_ids"], b["input_ids"])
+        np.testing.assert_array_equal(a["mlm_labels"], b["mlm_labels"])
+
+    def test_batch_larger_than_pool_raises(self):
+        from oktopk_tpu.data.synthetic import finite_pool_iterator
+        with pytest.raises(ValueError):
+            next(finite_pool_iterator("bert_tiny", 64, num_examples=32))
