@@ -29,9 +29,9 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 def run_one(model: str, compressor: str, steps: int, mesh, density: float,
             lr: float, out_dir: str, log_every: int = 10,
-            batch_size: int = 8):
+            batch_size: int = 8, warmup_steps: int = 0):
 
-    from oktopk_tpu.config import TrainConfig
+    from oktopk_tpu.config import OkTopkConfig, TrainConfig
     from oktopk_tpu.data.synthetic import (finite_pool_iterator,
                                            teacher_iterator)
     from oktopk_tpu.train.trainer import Trainer
@@ -39,7 +39,12 @@ def run_one(model: str, compressor: str, steps: int, mesh, density: float,
     cfg = TrainConfig(dnn=model, dataset="synthetic-teacher",
                       batch_size=batch_size, lr=lr, compressor=compressor,
                       density=density)
-    trainer = Trainer(cfg, mesh=mesh, warmup=False)
+    # dense warmup before sparsifying (reference VGG/allreducer.py:573 —
+    # 512 iters for VGG: early sparse training from a random init diverges,
+    # which is exactly what the warmup exists to prevent)
+    # warmup_steps=0 makes the warmup wrapper a no-op (with_warmup)
+    trainer = Trainer(cfg, mesh=mesh,
+                      algo_cfg=OkTopkConfig(warmup_steps=warmup_steps))
     P = trainer.cfg.num_workers
     # image workloads get teacher labels; token workloads (bert/lstm/ctc)
     # memorize a finite pool — both give a learnable, compressor-agnostic
@@ -90,6 +95,9 @@ def main():
     p.add_argument("--workers", type=int, default=8)
     p.add_argument("--density", type=float, default=0.05)
     p.add_argument("--lr", type=float, default=0.02)
+    p.add_argument("--warmup-steps", type=int, default=0,
+                   help="dense-allreduce steps before sparsifying "
+                        "(reference VGG/allreducer.py:573)")
     p.add_argument("--out", default="logs/convergence")
     args = p.parse_args()
 
@@ -106,7 +114,7 @@ def main():
     for model in args.models.split(","):
         for comp in args.compressors.split(","):
             run_one(model, comp, args.steps, mesh, args.density, args.lr,
-                    args.out)
+                    args.out, warmup_steps=args.warmup_steps)
 
 
 if __name__ == "__main__":
