@@ -2,37 +2,40 @@
 
 This is the selection hot path of every sparse collective (SURVEY.md §7.3.5).
 The portable implementation (ops/select.py ``select_mask``) builds a full-
-length cumsum and a full-length scatter — on TPU that scatter serialises and
-dominates the train step. TPU has no scatter unit, so the fast path is a
-Pallas kernel that does what the hardware is good at:
+length cumsum and a full-length scatter — on TPU the n-operand scatter
+serialises (~69 ms for n=14.7M on v5e, measured) and dominated the train
+step in rounds 1-2. TPU has no scatter unit, so the fast path splits the
+work by what the hardware is good at:
 
-  per 1024-element block (one [8, 128] f32 tile):
-    mask -> in-block exclusive prefix sum (7+3 shifted adds on the VPU)
-    -> per sublane-row transposed one-hot [capb, 128] (VPU compares; built
-       by sublane-broadcast + iota, never reshaping across lanes — Mosaic
-       rejects cross-lane shape casts like [8,128]->[1024,1])
-    -> eight [4, 128] x [capb, 128]^T MXU matmuls  (the "scatter")
-    -> sliced DMA append to the output at the running base offset.
+1. A Pallas *staging* kernel does the n-scale work: per 1024-element block
+   (one [8, 128] f32 tile), threshold-mask -> in-block exclusive prefix sum
+   (Hillis-Steele shifted adds on the VPU) -> one [1,128] x [capb,128]^T
+   MXU matmul per sublane row that drops each survivor's in-block offset
+   (< 1024, exact in f32 at Precision.HIGHEST) into its packed slot. Each
+   block writes its own staging row — standard blocked VMEM outputs, no
+   cross-block sequencing, so the grid pipelines freely.
+2. Plain-XLA post-processing does the cap-scale work with *gathers* (the
+   measured costs on v5e: gather ~10 ns/elem/round, cap-operand scatter
+   ~4.7 ns/elem, n-operand scatter ~4700 ns/1000 elem): a cumsum of the
+   per-block counts, a scatter-trick searchsorted (ones at each block's
+   cumulative count, then cumsum — replaces a log(nb) binary-search gather
+   chain), and 3 gather rounds to materialise (values, indices).
 
-The matmuls compact four row vectors at once: the value and the global index,
-each split into two 16-bit halves (every half is < 2^16, exact in f32; the
-dots run at Precision.HIGHEST because the default matmul path rounds MXU
-inputs to bf16's 8 mantissa bits; recombined by bit ops after the
-kernel). The running base lives in SMEM scratch and the grid is declared
-sequential ("arbitrary" dimension semantics), so each block's DMA lands after
-the previous block's — a block writes its full ``capb`` staging row and the
-next block's write overwrites the garbage tail, which is why the output
-carries ``capb`` slack slots that the caller masks off with the returned
-count.
+Why not DMA-append inside the kernel (the round-3 first attempt): Mosaic
+cannot slice a tiled VMEM scratch per row, and 1-D memrefs — HBM included —
+carry a (1024) tiling whose dynamic-offset slices need a divisibility
+proof that a running element count cannot give. Block-granular staging
+sidesteps every such constraint: all kernel outputs are statically blocked.
 
-``capb`` — the per-block staging width — is ``min(BLK, cap)`` rounded up to
-a lane multiple, which makes the kernel's retention *identical* to the
-portable path's lowest-index-first-within-``cap``: a block can never need to
-contribute more than min(its survivors, remaining cap) <= capb slots to the
-global first-``cap`` prefix. The one-hot compare cost scales with ``capb``,
-so callers with small caps (the in-band sparse regime, a few percent of a
-block) pay for a narrow 128-wide matmul while rare large-cap calls (the
-periodic exact recompute) widen it.
+Exactness: the staging width ``capb`` (128) caps how many survivors one
+block can stage. Blocks almost never exceed it in the threshold-band
+regime (~20 survivors/block at the paper's densities), but a correlated
+gradient can: the kernel therefore also emits *raw* per-block survivor
+counts, and the wrapper switches (``lax.cond``) to a capb=1024 kernel —
+which can never drop anything — whenever a block overflowed and the drop
+could matter. Both paths reproduce the portable result bit-for-bit
+(asserted in tests/test_compaction.py and on real hardware in
+tests/test_tpu_hw.py).
 
 The reference's analogous code is the boolean-mask nonzero select
 (``compressbythreshold``, VGG/compression.py:122-142) — a cheap op on GPU,
@@ -59,12 +62,12 @@ BLK_ROWS = 8          # f32 min tile is (8, 128)
 BLK_COLS = 128
 BLK = BLK_ROWS * BLK_COLS
 
+# sub-blocks per grid step: staging rows come 8 at a time so every output
+# block is a full (8, capb) tile — 2-D (1, capb) blocks fail the (8, 128)
+# divisibility rule and 1-D (capb,) blocks fail XLA's T(1024) layout
+SB = 8
 
-def _capb_for(cap: int) -> int:
-    """Per-block staging width: enough for any block's contribution to the
-    global first-``cap`` prefix, lane-aligned."""
-    need = min(BLK, cap)
-    return max(BLK_COLS, -(-need // BLK_COLS) * BLK_COLS)
+CAPB_FAST = 128       # staging width of the fast kernel (one lane row)
 
 
 def _shift_right(x, d, axis):
@@ -87,37 +90,25 @@ def _block_prefix(m):
     Only static positive slices and full reductions — scalar extraction
     like ``r[-1, 0]`` traces to ``dynamic_slice``, which Mosaic's TC
     lowering rejects (caught on the real chip; the interpreter accepts it).
+    The across-row scan runs full-width: a narrow ``[8, 1]`` slice of
+    column 127 keeps lane offset 127 in its vreg, and ``tpu.concatenate``
+    requires operands to agree on the non-concat (lane) offset — another
+    hardware-only constraint the interpreter accepts.
     """
     s = m
     for d in (1, 2, 4, 8, 16, 32, 64):           # within-row inclusive scan
         s = s + _shift_right(s, d, axis=1)
-    row_tot = s[:, BLK_COLS - 1:BLK_COLS]         # [8, 1]
-    r = row_tot
+    # per-row totals replicated across lanes (offset-0 layout)
+    rt = jnp.broadcast_to(s[:, BLK_COLS - 1:BLK_COLS], (BLK_ROWS, BLK_COLS))
+    r = rt
     for d in (1, 2, 4):                           # across-row inclusive scan
         r = r + _shift_right(r, d, axis=0)
-    row_excl = r - row_tot                        # exclusive row offsets
-    return s - m + row_excl, jnp.sum(m)           # (excl. positions, total)
+    return s - m + (r - rt), jnp.sum(m)           # (excl. positions, total)
 
 
-def _quantity_rows(x, gidx, kept):
-    """The four compacted quantities — value hi/lo half and global-index
-    hi/lo half — as separate [8, 128] i32 tiles, zeroed outside ``kept``.
-    16-bit pieces are exactly representable in f32 (|q| < 2^16 < 2^24),
-    but only survive the MXU when the dot runs at Precision.HIGHEST — see
-    ``_compact_tile``."""
-    from jax.experimental.pallas import tpu as pltpu
-
-    vbits = pltpu.bitcast(x, jnp.int32)
-    zero = jnp.zeros_like(vbits)
-    return (jnp.where(kept, vbits >> 16, zero),           # arithmetic shift
-            jnp.where(kept, vbits & 0xFFFF, zero),
-            jnp.where(kept, gidx >> 16, zero),
-            jnp.where(kept, gidx & 0xFFFF, zero))
-
-
-def _compact_tile(qs, sel, capb):
-    """The MXU "scatter": stage[s, j] = s-th quantity of the element whose
-    in-block slot is ``j``.
+def _stage_tile(woff, sel, capb):
+    """The MXU "scatter": stage[j] = in-block offset of the element whose
+    packed slot is ``j``, as one [1, capb] f32 row.
 
     Mosaic rejects cross-lane reshapes — the obvious ``[8,128] -> [BLK,1]``
     one-hot layout is an "unsupported shape cast" on real hardware (the
@@ -125,76 +116,133 @@ def _compact_tile(qs, sel, capb):
     everything stays in tile layout: per sublane-row, broadcast the row's
     slot vector along a fresh sublane axis, compare with a sublane iota to
     get the transposed one-hot [capb, 128], and contract both operands on
-    their lane axis (an NT matmul — dimension numbers ((1,),(1,))). Eight
-    [4,128] x [capb,128]^T matmuls replace the single [4,BLK] x [BLK,capb]
-    one; slots are distinct across rows so the accumulation is collision-
-    free and exact."""
+    their lane axis (an NT matmul — dimension numbers ((1,),(1,))). Slots
+    are distinct across rows so the accumulation is collision-free."""
     # i32 iota/compare: tpu.iota verifies only integer result types (a
     # float iota fails Mosaic verification on the real chip; the
     # interpreter accepts it)
     jio = jax.lax.broadcasted_iota(jnp.int32, (capb, BLK_COLS), 0)
-    acc = jnp.zeros((4, capb), jnp.float32)
+    acc = jnp.zeros((1, capb), jnp.float32)
     for r in range(BLK_ROWS):
         selr = jax.lax.slice(sel, (r, 0), (r + 1, BLK_COLS))   # [1, 128]
         onehot_t = (jnp.broadcast_to(selr, (capb, BLK_COLS)) == jio) \
             .astype(jnp.float32)                               # [capb, 128]
-        rows4 = jnp.concatenate(
-            [jax.lax.slice(q, (r, 0), (r + 1, BLK_COLS)).astype(jnp.float32)
-             for q in qs], axis=0)                             # [4, 128]
+        wr = jax.lax.slice(woff, (r, 0),
+                           (r + 1, BLK_COLS)).astype(jnp.float32)
         # HIGHEST precision: the default matmul path feeds the MXU bf16
-        # inputs (8 mantissa bits), silently rounding the 16-bit halves;
-        # HIGHEST decomposes f32 exactly, keeping one-hot x half exact.
+        # inputs (8 mantissa bits), silently rounding offsets > 256;
+        # HIGHEST decomposes f32 exactly, keeping one-hot x offset exact.
         acc = acc + jax.lax.dot_general(
-            rows4, onehot_t, (((1,), (1,)), ((), ())),
+            wr, onehot_t, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
             precision=jax.lax.Precision.HIGHEST)
     return acc
 
 
-def _compact_kernel(capb, t_ref, r_ref, x_ref, vh_ref, vl_ref, ih_ref,
-                    il_ref, cnt_ref, base_ref, stage_ref, sem_ref):
+def _stage_kernel(capb, t_ref, r_ref, x_ref, w_ref, cr_ref):
+    """Stage SB consecutive blocks: w_ref[s, j] = in-block offset of the
+    j-th survivor of sub-block s, cr_ref = raw survivor counts (broadcast
+    over 128 lanes; the stored count is min(raw, capb) by construction —
+    survivor ranks are dense — so it is derived in the wrapper, not
+    written)."""
+    import jax.experimental.pallas as pl
+
+    i = pl.program_id(0)
+    xs = x_ref[:]                                         # [SB*8, 128] f32
+    woff = (jax.lax.broadcasted_iota(jnp.int32, (BLK_ROWS, BLK_COLS), 0)
+            * BLK_COLS
+            + jax.lax.broadcasted_iota(jnp.int32, (BLK_ROWS, BLK_COLS), 1))
+    rows_w, rows_r = [], []
+    for sb in range(SB):
+        x = jax.lax.slice(xs, (sb * BLK_ROWS, 0),
+                          ((sb + 1) * BLK_ROWS, BLK_COLS))
+        gidx = (i * SB + sb) * BLK + woff
+        # [lo, hi) element-range restriction (region-restricted select);
+        # full range by default
+        mask = ((jnp.abs(x) >= t_ref[0])
+                & (gidx >= r_ref[0]) & (gidx < r_ref[1]))
+        m = mask.astype(jnp.int32)
+        pos, raw = _block_prefix(m)
+
+        kept = mask & (pos < capb)
+        sel = jnp.where(kept, pos, capb)                  # capb = dropped
+
+        rows_w.append(_stage_tile(jnp.where(kept, woff, 0), sel, capb))
+        rows_r.append(jnp.full((1, BLK_COLS), raw, jnp.int32))
+    w_ref[:] = jnp.concatenate(rows_w, axis=0)
+    cr_ref[:] = jnp.concatenate(rows_r, axis=0)
+
+
+def _run_stage(xp, t, rng, capb, nblocks, interpret, vma):
+    """pallas_call wrapper: (w_stage [nb, capb] f32, stored [nb], raw [nb])."""
     import jax.experimental.pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
-    i = pl.program_id(0)
-    nblocks = pl.num_programs(0)
+    out_shapes = [
+        jax.ShapeDtypeStruct((nblocks, capb), jnp.float32, vma=vma),
+        jax.ShapeDtypeStruct((nblocks, BLK_COLS), jnp.int32, vma=vma),
+    ]
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(nblocks // SB,),
+        in_specs=[pl.BlockSpec((SB * BLK_ROWS, BLK_COLS),
+                               lambda i, t, r: (i, 0))],
+        out_specs=[
+            pl.BlockSpec((SB, capb), lambda i, t, r: (i, 0)),
+            pl.BlockSpec((SB, BLK_COLS), lambda i, t, r: (i, 0)),
+        ],
+    )
+    w, cr = pl.pallas_call(
+        functools.partial(_stage_kernel, capb),
+        grid_spec=grid_spec,
+        out_shape=out_shapes,
+        interpret=interpret,
+    )(t, rng, xp)
+    raw = cr[:, 0]
+    return w, jnp.minimum(raw, capb), raw
 
-    @pl.when(i == 0)
-    def _():
-        base_ref[0] = 0
 
-    x = x_ref[:]                                          # [8, 128] f32
-    gidx = (i * BLK
-            + jax.lax.broadcasted_iota(jnp.int32, (BLK_ROWS, BLK_COLS), 0)
-            * BLK_COLS
-            + jax.lax.broadcasted_iota(jnp.int32, (BLK_ROWS, BLK_COLS), 1))
-    # [lo, hi) element-range restriction (region packing); full range for a
-    # whole-vector select
-    mask = ((jnp.abs(x) >= t_ref[0])
-            & (gidx >= r_ref[0]) & (gidx < r_ref[1]))
-    m = mask.astype(jnp.int32)
-    pos, _ = _block_prefix(m)
+def _searchsorted_scatter(csum, cap):
+    """For j in [0, cap): the number of entries of ``csum`` (a nondecreasing
+    i32 vector) that are <= j — i.e. searchsorted(csum, j, 'right') — via
+    one small scatter-add + a cap-scale cumsum instead of a log-round
+    binary-search gather chain (gathers cost ~10 ns/elem/round on v5e)."""
+    hits = jnp.zeros((cap + 1,), jnp.int32).at[
+        jnp.minimum(csum, cap)].add(1, mode="drop")
+    return jnp.cumsum(hits)[:cap]
 
-    kept = mask & (pos < capb)
-    sel = jnp.where(kept, pos, capb)                      # capb = dropped
-    stored = jnp.sum(kept.astype(jnp.int32))
 
-    stage_ref[:] = _compact_tile(_quantity_rows(x, gidx, kept), sel, capb)
+def _prep(x, thresh, lo, hi):
+    """Shared padding/threshold/range prep. Returns (xp2d, xflat, t, rng,
+    n, nblocks)."""
+    n = x.size
+    pad = (-n) % (SB * BLK)
+    xflat = jnp.pad(x.reshape(-1), (0, pad))
+    xp = xflat.reshape(-1, BLK_COLS)
+    nblocks = xp.shape[0] // BLK_ROWS
+    # clamp to the smallest normal f32: a zero/negative threshold selects
+    # every nonzero element rather than the padded tail (subnormals flush
+    # to zero on TPU anyway)
+    t = jnp.reshape(jnp.maximum(jnp.asarray(thresh, x.dtype),
+                                jnp.float32(1.17549435e-38)), (1,))
+    rng = jnp.stack([
+        jnp.asarray(0 if lo is None else lo, jnp.int32),
+        jnp.asarray(n if hi is None else hi, jnp.int32)])
+    return xp, xflat, t, rng, n, nblocks
 
-    base = base_ref[0]
-    cap = vh_ref.shape[0] - capb                          # slack appended
-    base_w = jnp.minimum(base, cap)
-    for j, out in enumerate((vh_ref, vl_ref, ih_ref, il_ref)):
-        copy = pltpu.make_async_copy(
-            stage_ref.at[j], out.at[pl.ds(base_w, capb)], sem_ref)
-        copy.start()
-        copy.wait()
 
-    base_ref[0] = base_w + stored
+def _vma_of(xp):
+    # under shard_map's VMA tracking the outputs vary over the same mesh
+    # axes as the input shard, and every operand must agree
+    try:
+        return jax.typeof(xp).vma
+    except Exception:
+        return frozenset()
 
-    @pl.when(i == nblocks - 1)
-    def _():
-        cnt_ref[0, 0] = jnp.minimum(base_ref[0], cap)     # stored (<= cap)
+
+def _pvary_to(arr, vma):
+    missing = tuple(vma - jax.typeof(arr).vma)
+    return jax.lax.pvary(arr, missing) if missing else arr
 
 
 @functools.partial(jax.jit, static_argnames=("cap", "interpret"))
@@ -207,136 +255,54 @@ def select_by_threshold_pallas(x: jnp.ndarray, thresh, cap: int,
     ``(values[cap], indices[cap], count)`` with slots >= count holding
     value 0 / index n, elements packed in ascending index order, overflow
     beyond ``cap`` dropped with lowest-index-first retention (identical to
-    the portable path — see the module docstring on ``capb``). ``lo``/``hi``
-    restrict selection to the element range [lo, hi) — the per-region form
-    used by region packing.
-
-    The threshold is clamped to the smallest normal f32, so a zero/negative
-    threshold selects every nonzero element rather than the padded tail
-    (subnormals flush to zero on TPU anyway).
+    the portable path). ``lo``/``hi`` restrict selection to the element
+    range [lo, hi).
     """
-    import jax.experimental.pallas as pl
-    from jax.experimental.pallas import tpu as pltpu
-
     if interpret is None:
         interpret = _interpret_default()
-    n = x.size
-    capb = _capb_for(cap)
-    pad = (-n) % BLK
-    xp = jnp.pad(x.reshape(-1), (0, pad)).reshape(-1, BLK_COLS)
-    nblocks = xp.shape[0] // BLK_ROWS
-    t = jnp.reshape(jnp.maximum(jnp.asarray(thresh, x.dtype),
-                                jnp.float32(1.17549435e-38)), (1,))
-    rng = jnp.stack([
-        jnp.asarray(0 if lo is None else lo, jnp.int32),
-        jnp.asarray(n if hi is None else hi, jnp.int32)])
-
-    # under shard_map's VMA tracking the outputs vary over the same mesh
-    # axes as the input shard, and every operand must agree
-    try:
-        vma = jax.typeof(xp).vma
-    except Exception:
-        vma = frozenset()
+    xp, xflat, t, rng, n, nblocks = _prep(x, thresh, lo, hi)
+    vma = _vma_of(xp)
     if vma:
-        t = jax.lax.pvary(t, tuple(vma - jax.typeof(t).vma))
-        rng = jax.lax.pvary(rng, tuple(vma - jax.typeof(rng).vma))
-    out_shapes = [jax.ShapeDtypeStruct((cap + capb,), jnp.float32, vma=vma)
-                  for _ in range(4)]
-    out_shapes.append(jax.ShapeDtypeStruct((1, 1), jnp.int32, vma=vma))
+        t = _pvary_to(t, vma)
+        rng = _pvary_to(rng, vma)
 
-    grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=2,
-        grid=(nblocks,),
-        in_specs=[pl.BlockSpec((BLK_ROWS, BLK_COLS),
-                               lambda i, t, r: (i, 0))],
-        out_specs=[pl.BlockSpec(memory_space=pl.ANY)] * 4
-        + [pl.BlockSpec(memory_space=pltpu.SMEM)],
-        scratch_shapes=[
-            pltpu.SMEM((1,), jnp.int32),
-            pltpu.VMEM((4, capb), jnp.float32),
-            pltpu.SemaphoreType.DMA,
-        ],
-    )
-    vh, vl, ih, il, cnts = pl.pallas_call(
-        functools.partial(_compact_kernel, capb),
-        grid_spec=grid_spec,
-        out_shape=out_shapes,
-        compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("arbitrary",)),
-        interpret=interpret,
-    )(t, rng, xp)
+    capb_f = CAPB_FAST
+    w_f, stored_f, raw = _run_stage(xp, t, rng, capb_f, nblocks, interpret,
+                                    vma)
+    count = jnp.minimum(jnp.sum(raw), cap)
 
-    count = cnts[0, 0]
-    live = jnp.arange(cap) < count
-    vbits = ((vh[:cap].astype(jnp.int32) << 16)
-             | (vl[:cap].astype(jnp.int32) & 0xFFFF))
-    values = jnp.where(live, jax.lax.bitcast_convert_type(vbits, jnp.float32),
-                       0.0)
-    indices = jnp.where(
-        live,
-        (ih[:cap].astype(jnp.int32) << 16)
-        | (il[:cap].astype(jnp.int32) & 0xFFFF),
-        n).astype(jnp.int32)
+    def _post(w_stage, stored, capb):
+        o_inc = jnp.cumsum(stored)                       # [nb]
+        b = _searchsorted_scatter(o_inc, cap)            # [cap]
+        b = jnp.minimum(b, nblocks - 1)
+        # flat staging slot of output j: b*capb + (j - O_excl[b]); the
+        # per-block part precombines into one gatherable vector
+        e = (jnp.arange(nblocks, dtype=jnp.int32) * capb
+             - (o_inc - stored))
+        j = jnp.arange(cap, dtype=jnp.int32)
+        flat = e[b] + j                                  # gather round 1
+        w = w_stage.reshape(-1)[jnp.clip(flat, 0, nblocks * capb - 1)] \
+            .astype(jnp.int32)                           # gather round 2
+        idx = b * BLK + w
+        live = j < count
+        values = jnp.where(live, xflat[idx], 0.0)        # gather round 3
+        indices = jnp.where(live, idx, n).astype(jnp.int32)
+        return values, indices
+
+    if cap > capb_f:
+        def wide(_):
+            w_w, stored_w, _raw = _run_stage(xp, t, rng, BLK, nblocks,
+                                             interpret, vma)
+            return _post(w_w, stored_w, BLK)
+
+        values, indices = jax.lax.cond(
+            jnp.any(raw > capb_f), wide,
+            lambda _: _post(w_f, stored_f, capb_f), None)
+    else:
+        # drops beyond capb have in-block position >= capb >= cap, hence
+        # global position >= cap: they can never make the first-cap prefix
+        values, indices = _post(w_f, stored_f, capb_f)
     return values, indices, count
-
-
-def _pack_regions_kernel(num_regions, capb, t_ref, b_ref, x_ref,
-                         vh_ref, vl_ref, ih_ref, il_ref, cnt_ref,
-                         base_ref, stage_ref, sem_ref):
-    """One sweep over x, packing each region's survivors into its own
-    fixed-capacity buffer (outputs are [num_regions, cap + capb]).
-
-    Per block, only the regions that intersect the block run their
-    compaction (predicated with @pl.when) — load-balanced regions are
-    contiguous spans much wider than one block, so typically 1-2 of the
-    ``num_regions`` iterations do work. This is what makes the whole
-    phase-(a) pack O(n) HBM reads instead of the per-region-call form's
-    O(P*n)."""
-    import jax.experimental.pallas as pl
-    from jax.experimental.pallas import tpu as pltpu
-
-    i = pl.program_id(0)
-    nblocks = pl.num_programs(0)
-
-    @pl.when(i == 0)
-    def _():
-        for r in range(num_regions):
-            base_ref[r] = 0
-
-    x = x_ref[:]                                          # [8, 128] f32
-    gidx = (i * BLK
-            + jax.lax.broadcasted_iota(jnp.int32, (BLK_ROWS, BLK_COLS), 0)
-            * BLK_COLS
-            + jax.lax.broadcasted_iota(jnp.int32, (BLK_ROWS, BLK_COLS), 1))
-    mask = jnp.abs(x) >= t_ref[0]
-    blk_start = i * BLK
-    blk_end = blk_start + BLK
-    cap = vh_ref.shape[1] - capb
-
-    for r in range(num_regions):
-        @pl.when((b_ref[r] < blk_end) & (b_ref[r + 1] > blk_start))
-        def _(r=r):
-            mask_r = mask & (gidx >= b_ref[r]) & (gidx < b_ref[r + 1])
-            m = mask_r.astype(jnp.int32)
-            pos, _ = _block_prefix(m)
-            kept = mask_r & (pos < capb)
-            sel = jnp.where(kept, pos, capb)
-            stored = jnp.sum(kept.astype(jnp.int32))
-            stage_ref[:] = _compact_tile(_quantity_rows(x, gidx, kept),
-                                         sel, capb)
-            base_w = jnp.minimum(base_ref[r], cap)
-            for j, out in enumerate((vh_ref, vl_ref, ih_ref, il_ref)):
-                copy = pltpu.make_async_copy(
-                    stage_ref.at[j], out.at[r, pl.ds(base_w, capb)],
-                    sem_ref)
-                copy.start()
-                copy.wait()
-            base_ref[r] = base_w + stored
-
-    @pl.when(i == nblocks - 1)
-    def _():
-        for r in range(num_regions):
-            cnt_ref[0, r] = jnp.minimum(base_ref[r], cap)
 
 
 @functools.partial(jax.jit,
@@ -349,69 +315,69 @@ def pack_by_region_pallas(x: jnp.ndarray, thresh, boundaries,
 
     ``boundaries``: i32 [num_regions + 1] cumulative offsets. Returns
     ``(values [R, cap], indices [R, cap], counts [R])`` with the same
-    contract as the portable path."""
-    import jax.experimental.pallas as pl
-    from jax.experimental.pallas import tpu as pltpu
-
+    contract as the portable path. The kernel is region-blind (regions are
+    contiguous index ranges, so the ascending-index staging is already
+    region-grouped); all region arithmetic happens in the cap-scale
+    post-processing.
+    """
     if interpret is None:
         interpret = _interpret_default()
-    n = x.size
-    capb = _capb_for(cap)
-    pad = (-n) % BLK
-    xp = jnp.pad(x.reshape(-1), (0, pad)).reshape(-1, BLK_COLS)
-    nblocks = xp.shape[0] // BLK_ROWS
-    t = jnp.reshape(jnp.maximum(jnp.asarray(thresh, x.dtype),
-                                jnp.float32(1.17549435e-38)), (1,))
-    b = jnp.asarray(boundaries, jnp.int32)
-
-    try:
-        vma = jax.typeof(xp).vma
-    except Exception:
-        vma = frozenset()
+    R = num_regions
+    xp, xflat, t, rng, n, nblocks = _prep(x, thresh, None, None)
+    vma = _vma_of(xp)
+    bnd = jnp.asarray(boundaries, jnp.int32)
     if vma:
-        t = jax.lax.pvary(t, tuple(vma - jax.typeof(t).vma))
-        b = jax.lax.pvary(b, tuple(vma - jax.typeof(b).vma))
-    out_shapes = [jax.ShapeDtypeStruct((num_regions, cap + capb),
-                                       jnp.float32, vma=vma)
-                  for _ in range(4)]
-    out_shapes.append(jax.ShapeDtypeStruct((1, num_regions), jnp.int32,
-                                           vma=vma))
+        t = _pvary_to(t, vma)
+        rng = _pvary_to(rng, vma)
 
-    grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=2,
-        grid=(nblocks,),
-        in_specs=[pl.BlockSpec((BLK_ROWS, BLK_COLS),
-                               lambda i, t, b: (i, 0))],
-        out_specs=[pl.BlockSpec(memory_space=pl.ANY)] * 4
-        + [pl.BlockSpec(memory_space=pltpu.SMEM)],
-        scratch_shapes=[
-            pltpu.SMEM((num_regions,), jnp.int32),
-            pltpu.VMEM((4, capb), jnp.float32),
-            pltpu.SemaphoreType.DMA,
-        ],
-    )
-    vh, vl, ih, il, cnts = pl.pallas_call(
-        functools.partial(_pack_regions_kernel, num_regions, capb),
-        grid_spec=grid_spec,
-        out_shape=out_shapes,
-        compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("arbitrary",)),
-        interpret=interpret,
-    )(t, b, xp)
+    w_f, stored_f, raw = _run_stage(xp, t, rng, CAPB_FAST, nblocks,
+                                    interpret, vma)
 
-    counts = cnts[0]                                     # [R]
-    live = jnp.arange(cap)[None, :] < counts[:, None]
-    vbits = ((vh[:, :cap].astype(jnp.int32) << 16)
-             | (vl[:, :cap].astype(jnp.int32) & 0xFFFF))
-    values = jnp.where(live,
-                       jax.lax.bitcast_convert_type(vbits, jnp.float32),
-                       0.0)
-    indices = jnp.where(
-        live,
-        (ih[:, :cap].astype(jnp.int32) << 16)
-        | (il[:, :cap].astype(jnp.int32) & 0xFFFF),
-        n).astype(jnp.int32)
-    return values, indices, counts
+    def _post(w_stage, stored, capb):
+        # region reconstruction requires every survivor staged, which the
+        # caller guarantees (no overflow, or the capb=BLK kernel)
+        bi = jnp.arange(nblocks, dtype=jnp.int32)
+        valid = (jnp.arange(capb, dtype=jnp.int32)[None, :]
+                 < stored[:, None])                       # [nb, capb]
+        idxg = (bi[:, None] * BLK + w_stage.astype(jnp.int32))
+        rid = jnp.zeros((nblocks, capb), jnp.int32)
+        for r in range(1, R):                             # region id/slot
+            rid = rid + (idxg >= bnd[r]).astype(jnp.int32)
+        # per-(block, region) survivor counts, via one small scatter-add
+        cnt_rb = jnp.zeros((nblocks, R), jnp.int32).at[
+            jnp.broadcast_to(bi[:, None], idxg.shape), rid].add(
+            valid.astype(jnp.int32))
+        off_rb = jnp.cumsum(cnt_rb, axis=1) - cnt_rb      # region start in row
+        c_rb = jnp.cumsum(cnt_rb, axis=0)                 # [nb, R] inclusive
+        counts = jnp.minimum(c_rb[-1], cap)               # [R]
+        # slot (r, j) -> source block: scatter-trick searchsorted per region
+        hits = jnp.zeros((R, cap + 1), jnp.int32).at[
+            jnp.broadcast_to(jnp.arange(R, dtype=jnp.int32)[None, :],
+                             (nblocks, R)),
+            jnp.minimum(c_rb, cap)].add(1, mode="drop")
+        b_sel = jnp.minimum(jnp.cumsum(hits, axis=1)[:, :cap],
+                            nblocks - 1)                  # [R, cap]
+        # flat staging slot: b*capb + off_rb[b, r] + (j - C_excl[b, r]);
+        # the per-(b, r) part precombines into one gatherable matrix
+        d_rb = (bi[:, None] * capb + off_rb - (c_rb - cnt_rb))  # [nb, R]
+        rr = jnp.arange(R, dtype=jnp.int32)[:, None]
+        j = jnp.arange(cap, dtype=jnp.int32)[None, :]
+        flat = (d_rb.reshape(-1)[b_sel * R + rr] + j)     # gather round 1
+        w = w_stage.reshape(-1)[jnp.clip(flat, 0, nblocks * capb - 1)] \
+            .astype(jnp.int32)                            # gather round 2
+        idx = b_sel * BLK + w
+        live = j < counts[:, None]
+        values = jnp.where(live, xflat[idx], 0.0)         # gather round 3
+        indices = jnp.where(live, idx, n).astype(jnp.int32)
+        return values, indices, counts
+
+    def wide(_):
+        w_w, stored_w, _raw = _run_stage(xp, t, rng, BLK, nblocks,
+                                         interpret, vma)
+        return _post(w_w, stored_w, BLK)
+
+    return jax.lax.cond(jnp.any(raw > CAPB_FAST), wide,
+                        lambda _: _post(w_f, stored_f, CAPB_FAST), None)
 
 
 def mesh_supports_pallas(mesh) -> bool:

@@ -1,0 +1,119 @@
+"""Micro-profile compaction strategies on the real chip.
+
+Decomposes the portable select (cumsum + scatter) and times a gather-based
+prototype (block counts + vectorized binary search + cap-scale gathers) to
+pick the TPU-native compaction design. Times include a ~10 ms tunnel
+dispatch floor per call (see `plain count` in profile_tpu.py).
+"""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _sync(out):
+    leaf = jax.tree_util.tree_leaves(out)[0]
+    np.asarray(leaf if leaf.ndim == 0 else leaf.reshape(-1)[0])
+
+
+def bench_fn(fn, *args, iters=10, warmup=2):
+    for _ in range(warmup):
+        out = fn(*args)
+    _sync(out)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(*args)
+    _sync(out)
+    return (time.perf_counter() - t0) / iters * 1e3
+
+
+BLK = 1024
+
+
+def select_gather(x, thresh, cap):
+    """Gather-based fixed-capacity select prototype (no scatter)."""
+    n = x.size
+    nb = n // BLK
+    mask2 = (jnp.abs(x) >= thresh).reshape(nb, BLK)
+    c = jnp.sum(mask2, axis=1)                      # [nb]
+    O = jnp.cumsum(c)                               # [nb] inclusive
+    Pincl = jnp.cumsum(mask2.astype(jnp.int32), axis=1)   # [nb, BLK]
+    count = jnp.minimum(O[-1], cap)
+    j = jnp.arange(cap, dtype=jnp.int32)
+    b = jnp.searchsorted(O, j, side="right").astype(jnp.int32)
+    bc = jnp.minimum(b, nb - 1)
+    rank = j - (O[bc] - c[bc]) + 1                  # 1-based rank in block
+    flatP = Pincl.reshape(-1)
+    lo = jnp.zeros((cap,), jnp.int32)
+    hi = jnp.full((cap,), BLK - 1, jnp.int32)
+    for _ in range(10):                             # log2(1024)
+        mid = (lo + hi) >> 1
+        v = flatP[bc * BLK + mid]
+        ge = v >= rank
+        hi = jnp.where(ge, mid, hi)
+        lo = jnp.where(ge, lo, mid + 1)
+    idx = bc * BLK + hi
+    live = j < count
+    values = jnp.where(live, x[idx], 0.0)
+    indices = jnp.where(live, idx, n).astype(jnp.int32)
+    return values, indices, count
+
+
+def main():
+    dev = jax.devices()[0]
+    print(f"device: {dev}", flush=True)
+    n = 14_700_000
+    for a in sys.argv[1:]:
+        if a.startswith("--n="):
+            n = int(a.split("=", 1)[1])
+    n = (n // BLK) * BLK
+    k = int(0.02 * n)
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(n).astype(np.float32))
+    t = jnp.float32(2.054)
+    cap = int(2.0 * k / 8) + 8
+
+    f_mask = jax.jit(lambda v: jnp.sum(jnp.abs(v) >= t))
+    print(f"mask+count: {bench_fn(f_mask, x):.1f} ms", flush=True)
+
+    f_cumsum = jax.jit(lambda v: jnp.cumsum(jnp.abs(v) >= t)[-1])
+    print(f"flat cumsum(n): {bench_fn(f_cumsum, x):.1f} ms", flush=True)
+
+    f_cumsum2 = jax.jit(lambda v: jnp.cumsum(
+        (jnp.abs(v) >= t).reshape(-1, BLK).astype(jnp.int32), axis=1)[-1, -1])
+    print(f"blocked cumsum(nb,1024) axis1: {bench_fn(f_cumsum2, x):.1f} ms",
+          flush=True)
+
+    def scatter_only(v):
+        mask = jnp.abs(v) >= t
+        pos = jnp.cumsum(mask) - 1
+        pos = jnp.where(mask & (pos < cap), pos, cap)
+        return jnp.zeros((cap,), v.dtype).at[pos].set(
+            jnp.where(mask, v, 0), mode="drop")[0]
+    print(f"cumsum+scatter (portable core): "
+          f"{bench_fn(jax.jit(scatter_only), x):.1f} ms", flush=True)
+
+    f_g = jax.jit(lambda v: select_gather(v, t, cap))
+    print(f"select_gather proto (cap={cap}): {bench_fn(f_g, x):.1f} ms",
+          flush=True)
+
+    # parity check vs portable
+    from oktopk_tpu.ops.select import select_by_threshold
+    gv, gi, gc = map(np.asarray, f_g(x))
+    wv, wi, wc = map(np.asarray, select_by_threshold(x, t, cap))
+    print(f"parity: count {gc == wc}, idx {np.array_equal(gi, wi)}, "
+          f"val {np.array_equal(gv, wv)}", flush=True)
+
+    cap_big = 2 * k + 8
+    f_gb = jax.jit(lambda v: select_gather(v, t, cap_big))
+    print(f"select_gather proto (cap={cap_big}): {bench_fn(f_gb, x):.1f} ms",
+          flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -38,7 +38,8 @@ class TestCompactionParity:
     def test_bit_exact_values(self):
         rng = np.random.RandomState(1)
         # adversarial float bit patterns: subnormals excluded (threshold),
-        # but mixed signs/exponents must survive the 16-bit split exactly
+        # but mixed signs/exponents must come back bit-exact through the
+        # staging offsets + value gather
         x = (rng.randn(2 * BLK) * 10.0 ** rng.randint(-6, 6, 2 * BLK))
         x = x.astype(np.float32)
         t = float(np.quantile(np.abs(x), 0.97))
