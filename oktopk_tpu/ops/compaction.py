@@ -16,10 +16,12 @@ work by what the hardware is good at:
    cross-block sequencing, so the grid pipelines freely.
 2. Plain-XLA post-processing does the cap-scale work with *gathers* (the
    measured costs on v5e: gather ~10 ns/elem/round, cap-operand scatter
-   ~4.7 ns/elem, n-operand scatter ~4700 ns/1000 elem): a cumsum of the
-   per-block counts, a scatter-trick searchsorted (ones at each block's
-   cumulative count, then cumsum — replaces a log(nb) binary-search gather
-   chain), and 3 gather rounds to materialise (values, indices).
+   ~4.7 ns/elem, n-operand scatter ~4700 ns/1000 elem): the per-output-slot
+   staging address and element base both *telescope* along the output axis
+   (crossing a block's end advances them by fixed per-block jumps), so one
+   small scatter-add of the jumps + a cap-scale cumsum replaces any
+   searchsorted/base-gather, leaving exactly 2 cap-scale gather rounds
+   (the staged offset, then the value) — see ``_materialize``.
 
 Why not DMA-append inside the kernel (the round-3 first attempt): Mosaic
 cannot slice a tiled VMEM scratch per row, and 1-D memrefs — HBM included —
@@ -202,14 +204,46 @@ def _run_stage(xp, t, rng, capb, nblocks, interpret, vma):
     return w, jnp.minimum(raw, capb), raw
 
 
-def _searchsorted_scatter(csum, cap):
-    """For j in [0, cap): the number of entries of ``csum`` (a nondecreasing
-    i32 vector) that are <= j — i.e. searchsorted(csum, j, 'right') — via
-    one small scatter-add + a cap-scale cumsum instead of a log-round
-    binary-search gather chain (gathers cost ~10 ns/elem/round on v5e)."""
-    hits = jnp.zeros((cap + 1,), jnp.int32).at[
-        jnp.minimum(csum, cap)].add(1, mode="drop")
-    return jnp.cumsum(hits)[:cap]
+def _materialize(w_stage, xflat, cnt_rb, off_rb, capb, cap, counts, n):
+    """Materialise ``(values [R, cap], indices [R, cap])`` from a packed
+    staging ``w_stage [nb, capb]`` whose block b holds (ascending-index)
+    the survivors counted by ``cnt_rb [nb, R]`` per region, region r's run
+    starting at in-row offset ``off_rb[b, r]`` (None = zeros, the R=1
+    whole-vector select).
+
+    Region r's output slot j reads staging slot
+        b*capb + off_rb[b, r] + (j - C_excl[b, r])
+    of block b = searchsorted(C[:, r], j), and its element index is
+    b*BLK + staged offset. Both per-slot bases *telescope* along j:
+    crossing block b (at output position C[b, r]) advances the staging
+    base by capb + off_rb[b+1, r] - off_rb[b, r] - cnt_rb[b, r] and the
+    element base by BLK, starting from off_rb[0, r] and 0. One small
+    scatter-add of those jumps + a per-row cap-scale cumsum therefore
+    replaces any searchsorted and per-slot base gather; only two
+    cap-scale gather rounds remain (the staged offset, then the value).
+    """
+    nblocks, R = cnt_rb.shape
+    if off_rb is None:
+        off_rb = jnp.zeros_like(cnt_rb)
+    c_rb = jnp.cumsum(cnt_rb, axis=0)                 # [nb, R] inclusive
+    off_next = jnp.concatenate([off_rb[1:], off_rb[-1:]], axis=0)
+    fval = capb + off_next - off_rb - cnt_rb          # [nb, R]
+    pos = jnp.minimum(c_rb, cap)
+    rgrid = jnp.broadcast_to(jnp.arange(R, dtype=jnp.int32)[None, :],
+                             (nblocks, R))
+    fjump = jnp.zeros((R, cap + 1), jnp.int32).at[rgrid.T, pos.T].add(fval.T)
+    gjump = jnp.zeros((R, cap + 1), jnp.int32).at[rgrid.T, pos.T].add(BLK)
+    j = jnp.arange(cap, dtype=jnp.int32)[None, :]
+    flat = off_rb[0][:, None] + jnp.cumsum(fjump, axis=1)[:, :cap] + j
+    gbase = jnp.cumsum(gjump, axis=1)[:, :cap]        # = source block * BLK
+    w = w_stage.reshape(-1)[jnp.clip(flat, 0, nblocks * capb - 1)] \
+        .astype(jnp.int32)                            # gather round 1
+    idx = gbase + w
+    live = j < counts[:, None]
+    values = jnp.where(live, xflat[jnp.minimum(idx, xflat.size - 1)],
+                       0.0)                           # gather round 2
+    indices = jnp.where(live, idx, n).astype(jnp.int32)
+    return values, indices
 
 
 def _prep(x, thresh, lo, hi):
@@ -272,22 +306,10 @@ def select_by_threshold_pallas(x: jnp.ndarray, thresh, cap: int,
     count = jnp.minimum(jnp.sum(raw), cap)
 
     def _post(w_stage, stored, capb):
-        o_inc = jnp.cumsum(stored)                       # [nb]
-        b = _searchsorted_scatter(o_inc, cap)            # [cap]
-        b = jnp.minimum(b, nblocks - 1)
-        # flat staging slot of output j: b*capb + (j - O_excl[b]); the
-        # per-block part precombines into one gatherable vector
-        e = (jnp.arange(nblocks, dtype=jnp.int32) * capb
-             - (o_inc - stored))
-        j = jnp.arange(cap, dtype=jnp.int32)
-        flat = e[b] + j                                  # gather round 1
-        w = w_stage.reshape(-1)[jnp.clip(flat, 0, nblocks * capb - 1)] \
-            .astype(jnp.int32)                           # gather round 2
-        idx = b * BLK + w
-        live = j < count
-        values = jnp.where(live, xflat[idx], 0.0)        # gather round 3
-        indices = jnp.where(live, idx, n).astype(jnp.int32)
-        return values, indices
+        values, indices = _materialize(
+            w_stage, xflat, stored[:, None], None, capb, cap,
+            count[None], n)
+        return values[0], indices[0]
 
     if cap > capb_f:
         def wide(_):
@@ -348,27 +370,9 @@ def pack_by_region_pallas(x: jnp.ndarray, thresh, boundaries,
             jnp.broadcast_to(bi[:, None], idxg.shape), rid].add(
             valid.astype(jnp.int32))
         off_rb = jnp.cumsum(cnt_rb, axis=1) - cnt_rb      # region start in row
-        c_rb = jnp.cumsum(cnt_rb, axis=0)                 # [nb, R] inclusive
-        counts = jnp.minimum(c_rb[-1], cap)               # [R]
-        # slot (r, j) -> source block: scatter-trick searchsorted per region
-        hits = jnp.zeros((R, cap + 1), jnp.int32).at[
-            jnp.broadcast_to(jnp.arange(R, dtype=jnp.int32)[None, :],
-                             (nblocks, R)),
-            jnp.minimum(c_rb, cap)].add(1, mode="drop")
-        b_sel = jnp.minimum(jnp.cumsum(hits, axis=1)[:, :cap],
-                            nblocks - 1)                  # [R, cap]
-        # flat staging slot: b*capb + off_rb[b, r] + (j - C_excl[b, r]);
-        # the per-(b, r) part precombines into one gatherable matrix
-        d_rb = (bi[:, None] * capb + off_rb - (c_rb - cnt_rb))  # [nb, R]
-        rr = jnp.arange(R, dtype=jnp.int32)[:, None]
-        j = jnp.arange(cap, dtype=jnp.int32)[None, :]
-        flat = (d_rb.reshape(-1)[b_sel * R + rr] + j)     # gather round 1
-        w = w_stage.reshape(-1)[jnp.clip(flat, 0, nblocks * capb - 1)] \
-            .astype(jnp.int32)                            # gather round 2
-        idx = b_sel * BLK + w
-        live = j < counts[:, None]
-        values = jnp.where(live, xflat[idx], 0.0)         # gather round 3
-        indices = jnp.where(live, idx, n).astype(jnp.int32)
+        counts = jnp.minimum(jnp.sum(cnt_rb, axis=0), cap)  # [R]
+        values, indices = _materialize(
+            w_stage, xflat, cnt_rb, off_rb, capb, cap, counts, n)
         return values, indices, counts
 
     def wide(_):
