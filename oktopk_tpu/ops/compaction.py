@@ -219,8 +219,10 @@ def _materialize(w_stage, xflat, cnt_rb, off_rb, capb, cap, counts, n):
     base by capb + off_rb[b+1, r] - off_rb[b, r] - cnt_rb[b, r] and the
     element base by BLK, starting from off_rb[0, r] and 0. One small
     scatter-add of those jumps + a per-row cap-scale cumsum therefore
-    replaces any searchsorted and per-slot base gather; only two
-    cap-scale gather rounds remain (the staged offset, then the value).
+    replaces any searchsorted and per-slot base gather (the element base
+    needs no accumulator of its own: a live slot's in-row offset is < capb,
+    so its block is ``flat // capb``); only two cap-scale gather rounds
+    remain (the staged offset, then the value).
     """
     nblocks, R = cnt_rb.shape
     if off_rb is None:
@@ -232,13 +234,14 @@ def _materialize(w_stage, xflat, cnt_rb, off_rb, capb, cap, counts, n):
     rgrid = jnp.broadcast_to(jnp.arange(R, dtype=jnp.int32)[None, :],
                              (nblocks, R))
     fjump = jnp.zeros((R, cap + 1), jnp.int32).at[rgrid.T, pos.T].add(fval.T)
-    gjump = jnp.zeros((R, cap + 1), jnp.int32).at[rgrid.T, pos.T].add(BLK)
     j = jnp.arange(cap, dtype=jnp.int32)[None, :]
     flat = off_rb[0][:, None] + jnp.cumsum(fjump, axis=1)[:, :cap] + j
-    gbase = jnp.cumsum(gjump, axis=1)[:, :cap]        # = source block * BLK
-    w = w_stage.reshape(-1)[jnp.clip(flat, 0, nblocks * capb - 1)] \
-        .astype(jnp.int32)                            # gather round 1
-    idx = gbase + w
+    # live slots always sit inside their block's staging row (in-row offset
+    # < capb), so the source block is just flat // capb — a shift, no
+    # second jump accumulator needed
+    flat = jnp.clip(flat, 0, nblocks * capb - 1)
+    w = w_stage.reshape(-1)[flat].astype(jnp.int32)   # gather round 1
+    idx = (flat // capb) * BLK + w
     live = j < counts[:, None]
     values = jnp.where(live, xflat[jnp.minimum(idx, xflat.size - 1)],
                        0.0)                           # gather round 2
