@@ -136,34 +136,43 @@ class TestPackRegionsParity:
         np.testing.assert_array_equal(gv, wv)
 
 
+def _run_oktopk_both_paths(mesh8, cfg0, base, steps):
+    """Run the full oktopk step for use_pallas False/True on the same data;
+    returns ({use_pallas: [per-step results]}, {use_pallas: final state})."""
+    from oktopk_tpu.collectives.api import (batched_init_state,
+                                            build_allreduce_step)
+
+    outs, states = {}, {}
+    for up in (False, True):
+        cfg = cfg0.replace(use_pallas=up)
+        # check_vma=False: the Pallas interpreter cannot mix VMA-tracked
+        # operands (real-TPU compiles through Mosaic instead)
+        step = build_allreduce_step("oktopk", cfg, mesh8, warmup=False,
+                                    check_vma=not up)
+        state = batched_init_state(cfg)
+        rs = []
+        for _ in range(steps):
+            out, state = step(jnp.asarray(base), state)
+            rs.append(np.asarray(out[0]))
+        outs[up], states[up] = rs, state
+    return outs, states
+
+
 class TestOkTopkPallasParity:
     def test_full_algorithm_matches_portable(self, mesh8, monkeypatch):
         """The whole oktopk step with the Pallas selection path (interpret
         mode) must produce the same reduced result, volumes and state as
         the portable path when counts sit inside the capacity bounds."""
         monkeypatch.setenv("OKTOPK_PALLAS_INTERPRET", "1")
-        from oktopk_tpu.collectives.api import (batched_init_state,
-                                                build_allreduce_step)
         from oktopk_tpu.config import OkTopkConfig
 
         P, n = 8, 8192
         rng = np.random.RandomState(4)
         base = rng.randn(P, n).astype(np.float32)
-        outs, states = {}, {}
-        for up in (False, True):
-            cfg = OkTopkConfig(n=n, num_workers=P, density=0.05,
-                               warmup_steps=0, local_recompute_every=2,
-                               global_recompute_every=4, use_pallas=up)
-            # check_vma=False: the Pallas interpreter cannot mix VMA-tracked
-            # operands (real-TPU compiles through Mosaic instead)
-            step = build_allreduce_step("oktopk", cfg, mesh8, warmup=False,
-                                        check_vma=not up)
-            state = batched_init_state(cfg)
-            rs = []
-            for i in range(4):
-                out, state = step(jnp.asarray(base), state)
-                rs.append(np.asarray(out[0]))
-            outs[up], states[up] = rs, state
+        cfg0 = OkTopkConfig(n=n, num_workers=P, density=0.05,
+                            warmup_steps=0, local_recompute_every=2,
+                            global_recompute_every=4)
+        outs, states = _run_oktopk_both_paths(mesh8, cfg0, base, steps=4)
         for a, b in zip(outs[False], outs[True]):
             np.testing.assert_allclose(a, b, atol=1e-6)
         np.testing.assert_allclose(
@@ -172,3 +181,33 @@ class TestOkTopkPallasParity:
         np.testing.assert_allclose(
             np.asarray(states[False].residual),
             np.asarray(states[True].residual), atol=1e-6)
+
+    def test_full_algorithm_overflow_takes_wide_path(self, mesh8,
+                                                     monkeypatch):
+        """Spatially concentrated gradients overflow the CAPB_FAST staging
+        in the hot blocks, so the algorithm-level step must take the
+        capb=BLK wide-kernel cond branch under shard_map — and still match
+        the portable path. (The unit tests exercise overflow outside
+        shard_map; this pins the cond wiring inside the real step.)"""
+        monkeypatch.setenv("OKTOPK_PALLAS_INTERPRET", "1")
+        from oktopk_tpu.config import OkTopkConfig
+        from oktopk_tpu.ops.compaction import CAPB_FAST
+
+        P, n = 8, 8192
+        rng = np.random.RandomState(9)
+        # hot first block: far more than CAPB_FAST survivors land in one
+        # 1024-element block; elsewhere near-silence
+        base = 0.01 * rng.randn(P, n).astype(np.float32)
+        base[:, :BLK] = 10.0 * rng.randn(P, BLK).astype(np.float32)
+        cfg0 = OkTopkConfig(n=n, num_workers=P, density=0.2,
+                            warmup_steps=0, local_recompute_every=2,
+                            global_recompute_every=4)
+        assert cfg0.cap_pair > CAPB_FAST   # overflow can matter => wide path
+        outs, _ = _run_oktopk_both_paths(mesh8, cfg0, base, steps=3)
+        # the wide branch really fired: more than CAPB_FAST of the hot
+        # block's elements made the global result, so its raw survivor
+        # count (a superset) must have exceeded the fast staging width
+        assert (outs[False][0][:BLK] != 0).sum() > CAPB_FAST
+        for a, b in zip(outs[False], outs[True]):
+            assert np.isfinite(a).all()
+            np.testing.assert_allclose(a, b, atol=1e-6)
