@@ -11,10 +11,27 @@ import numpy as np
 
 def synthetic_batch(dnn: str, batch_size: int, rng: np.random.RandomState,
                     seq_len: int = None) -> Dict[str, np.ndarray]:
-    if dnn == "lstm":
+    if dnn in ("lstm", "lstm_tiny"):
         t = seq_len or 35
-        vocab = 10000
-        toks = rng.randint(0, vocab, size=(batch_size, t + 1))
+        vocab = 1024 if dnn == "lstm_tiny" else 10000
+        # Bigram-structured sequences (fixed random successor table, 10%
+        # uniform noise): uniform-random tokens carry no learnable signal
+        # beyond rote memorization, which makes LM loss curves useless for
+        # algorithm comparisons; a bigram chain gives every optimizer the
+        # same structured next-token task (entropy floor ~0.1*ln(V)), the
+        # LM analogue of teacher_iterator's linear teacher for images.
+        # The table comes from its own fixed-seed stream — drawing it from
+        # ``rng`` would hand the infinite synthetic_iterator a fresh table
+        # every batch, leaving no cross-batch signal to learn.
+        trans = np.random.RandomState(vocab + 17).randint(
+            0, vocab, size=(vocab,))
+        toks = np.empty((batch_size, t + 1), np.int64)
+        toks[:, 0] = rng.randint(0, vocab, size=(batch_size,))
+        for i in range(t):
+            noise = rng.rand(batch_size) < 0.1
+            toks[:, i + 1] = np.where(
+                noise, rng.randint(0, vocab, size=(batch_size,)),
+                trans[toks[:, i]])
         return {"tokens": toks[:, :-1].astype(np.int32),
                 "targets": toks[:, 1:].astype(np.int32)}
     if dnn.startswith("bert"):

@@ -47,6 +47,15 @@ MODELS: Dict[str, Callable[..., Tuple[Any, Callable]]] = {
     "caffe_cifar": lambda **kw: (CaffeCifar(**kw), _img(32, 32, 3)),
     "mnistnet": lambda **kw: (MnistNet(**kw), _img(28, 28, 1)),
     "lstm": lambda **kw: (PTBLSTM(**kw), _tokens(35, 10000)),
+    # CPU-mesh-sized PTB LSTM (convergence evidence for the LSTM family,
+    # the role bert_tiny plays for BERT). No dropout: the convergence probe
+    # memorizes a finite pool, where the reference's keep=0.35 (applied
+    # after the embedding and every layer) only drowns the algorithm
+    # comparison in noise.
+    "lstm_tiny": lambda **kw: (
+        PTBLSTM(**{"vocab_size": 1024, "hidden_size": 192,
+                   "dropout_keep": 1.0, **kw}),
+        _tokens(35, 1024)),
     "lstman4": lambda **kw: (DeepSpeech(**kw),
                              lambda bs: jnp.zeros((bs, 161, 201, 1),
                                                   jnp.float32)),

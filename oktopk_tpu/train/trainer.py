@@ -127,7 +127,7 @@ class Trainer:
 
     def _init_variables(self, rng, batch):
         rngs = {"params": rng, "dropout": jax.random.fold_in(rng, 1)}
-        if self.cfg.dnn == "lstm":
+        if self.cfg.dnn in ("lstm", "lstm_tiny"):
             return self.model.init(rngs, batch["tokens"], train=False)
         if self.cfg.dnn.startswith("bert"):
             return self.model.init(rngs, batch["input_ids"],
@@ -140,7 +140,7 @@ class Trainer:
     def _example_batch(self, bs: int):
         """Zero-filled batch with the workload's shapes (for init/tracing)."""
         dnn = self.cfg.dnn
-        if dnn == "lstm":
+        if dnn in ("lstm", "lstm_tiny"):
             t = 35
             return {"tokens": jnp.zeros((bs, t), jnp.int32),
                     "targets": jnp.zeros((bs, t), jnp.int32)}
@@ -166,7 +166,7 @@ class Trainer:
         mutable = [k for k in model_state]
         rngs = {"dropout": rng}
 
-        if dnn == "lstm":
+        if dnn in ("lstm", "lstm_tiny"):
             (logits, _), mut = self.model.apply(
                 variables, batch["tokens"], train=True, mutable=mutable,
                 rngs=rngs)
@@ -314,7 +314,7 @@ class Trainer:
         params = self.state.params
         variables = {"params": params, **self.state.model_state}
         dnn = self.cfg.dnn
-        if dnn == "lstm":
+        if dnn in ("lstm", "lstm_tiny"):
             logits, _ = self.model.apply(variables, batch["tokens"],
                                          train=False)
             loss = losses.lm_cross_entropy(logits, batch["targets"])

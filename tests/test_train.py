@@ -67,6 +67,14 @@ class TestWorkloads:
         m = run_steps(tr, 2, 4)
         assert np.isfinite(float(m["loss"]))
 
+    def test_lstm_tiny_oktopk(self, mesh4):
+        # the registry's CPU-mesh-sized LSTM (convergence-evidence variant)
+        cfg = TrainConfig(dnn="lstm_tiny", dataset="ptb", batch_size=4,
+                          lr=2.0, compressor="oktopk", density=0.05)
+        tr = Trainer(cfg, mesh=mesh4, warmup=False)
+        m = run_steps(tr, 2, 4)
+        assert np.isfinite(float(m["loss"]))
+
     def test_bert_tiny_oktopk(self, mesh4):
         cfg = TrainConfig(dnn="bert_tiny", dataset="wikipedia", batch_size=4,
                           lr=1e-3, compressor="oktopk", density=0.05,
