@@ -320,8 +320,14 @@ def select_by_threshold_pallas(x: jnp.ndarray, thresh, cap: int,
                                              interpret, vma)
             return _post(w_w, stored_w, BLK)
 
+        # A block's drops have in-block position >= capb, hence global
+        # survivor rank >= excl_cumsum(raw)[b] + capb. When every drop
+        # ranks >= cap, no output slot can see one (a survivor with true
+        # rank < cap has no drop before it either, so the stored ordering
+        # of the first cap slots is exact) — skip the full-width re-stage.
+        excl = jnp.cumsum(raw) - raw
         values, indices = jax.lax.cond(
-            jnp.any(raw > capb_f), wide,
+            jnp.any((raw > capb_f) & (excl + capb_f < cap)), wide,
             lambda _: _post(w_f, stored_f, capb_f), None)
     else:
         # drops beyond capb have in-block position >= capb >= cap, hence
@@ -330,21 +336,51 @@ def select_by_threshold_pallas(x: jnp.ndarray, thresh, cap: int,
     return values, indices, count
 
 
-@functools.partial(jax.jit,
-                   static_argnames=("num_regions", "cap", "interpret"))
 def pack_by_region_pallas(x: jnp.ndarray, thresh, boundaries,
                           num_regions: int, cap: int,
                           interpret: bool | None = None):
     """Pack ``|x| >= thresh`` into per-region fixed-capacity buffers in ONE
     pass over ``x`` (the Pallas fast path of ops.select.pack_by_region).
 
-    ``boundaries``: i32 [num_regions + 1] cumulative offsets. Returns
-    ``(values [R, cap], indices [R, cap], counts [R])`` with the same
-    contract as the portable path. The kernel is region-blind (regions are
-    contiguous index ranges, so the ascending-index staging is already
-    region-grouped); all region arithmetic happens in the cap-scale
-    post-processing.
+    ``boundaries``: i32 [num_regions + 1] cumulative offsets that MUST span
+    exactly [0, n]: ``boundaries[0] == 0`` and ``boundaries[-1] == n``.
+    The kernel is region-blind (it stages every survivor over [0, n); the
+    post-processing assigns region ids from the interior boundaries only),
+    so a survivor outside ``[boundaries[0], boundaries[-1])`` would be
+    silently attributed to the first/last region rather than masked out.
+    ``_repartition`` maintains the invariant by construction (the
+    reference asserts the same: sum of region sizes == n,
+    VGG/allreducer.py:648); callers with concrete boundaries get a cheap
+    host-side check. Returns ``(values [R, cap], indices [R, cap],
+    counts [R])`` with the same contract as the portable path. The
+    ascending-index staging is already region-grouped (regions are
+    contiguous index ranges); all region arithmetic happens in the
+    cap-scale post-processing.
     """
+    # The invariant check must run BEFORE jit: inside the trace every
+    # array is a tracer (isinstance(np.ndarray) is False and np.asarray
+    # raises), so a guard in the jitted body can never fire. Concrete
+    # boundaries (numpy / committed jax arrays / int sequences) convert;
+    # tracers (e.g. the jitted oktopk caller, whose _repartition keeps
+    # the invariant by construction) raise and skip the check.
+    try:
+        b = np.asarray(boundaries)
+        concrete = b.dtype != object
+    except Exception:
+        concrete = False
+    if concrete and (b[0] != 0 or b[-1] != x.size):
+        raise ValueError(
+            f"boundaries must span exactly [0, n={x.size}]; got "
+            f"[{b[0]}, {b[-1]}] (the kernel is region-blind — see "
+            "docstring)")
+    return _pack_by_region_pallas(x, thresh, boundaries, num_regions, cap,
+                                  interpret)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("num_regions", "cap", "interpret"))
+def _pack_by_region_pallas(x, thresh, boundaries, num_regions: int,
+                           cap: int, interpret: bool | None = None):
     if interpret is None:
         interpret = _interpret_default()
     R = num_regions
@@ -365,9 +401,12 @@ def pack_by_region_pallas(x: jnp.ndarray, thresh, boundaries,
         valid = (jnp.arange(capb, dtype=jnp.int32)[None, :]
                  < stored[:, None])                       # [nb, capb]
         idxg = (bi[:, None] * BLK + w_stage.astype(jnp.int32))
-        rid = jnp.zeros((nblocks, capb), jnp.int32)
-        for r in range(1, R):                             # region id/slot
-            rid = rid + (idxg >= bnd[r]).astype(jnp.int32)
+        # region id = #interior boundaries <= idxg: O(staged * log R)
+        # searchsorted (matching the portable path) instead of an R-1 loop
+        # of dense [nb, capb] compares, which scales linearly with the
+        # region/worker count
+        rid = jnp.searchsorted(bnd[1:-1], idxg,
+                               side="right").astype(jnp.int32)
         # per-(block, region) survivor counts, via one small scatter-add
         cnt_rb = jnp.zeros((nblocks, R), jnp.int32).at[
             jnp.broadcast_to(bi[:, None], idxg.shape), rid].add(
