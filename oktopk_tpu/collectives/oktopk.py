@@ -36,7 +36,7 @@ from jax import lax
 from oktopk_tpu.collectives.state import SparseState, bump
 from oktopk_tpu.comm import all_gather, all_to_all, axis_rank, psum
 from oktopk_tpu.comm.primitives import pvary_like
-from oktopk_tpu.config import OkTopkConfig
+from oktopk_tpu.config import OkTopkConfig, scheduled_k
 from oktopk_tpu.ops import (
     pack_by_region,
     scatter_sparse,
@@ -110,7 +110,11 @@ def _repartition(abs_acc, local_thresh, cfg: OkTopkConfig, axis_name: str):
 
 def oktopk(grad: jnp.ndarray, state: SparseState, cfg: OkTopkConfig,
            axis_name: str = "data"):
-    P, n, k = cfg.num_workers, cfg.n, cfg.k
+    P, n = cfg.num_workers, cfg.n
+    # With a density_schedule, k is a traced scalar of the step counter:
+    # the threshold controller chases the scheduled target while every
+    # fixed-capacity buffer stays sized by the max density (config.py).
+    k = scheduled_k(cfg, state.step)
     rank = axis_rank(axis_name)
     acc = add_residual(grad, state.residual)
     abs_acc = jnp.abs(acc)
@@ -217,7 +221,12 @@ def oktopk(grad: jnp.ndarray, state: SparseState, cfg: OkTopkConfig,
         gv = all_gather(_on_wire(vals, cfg), axis_name) \
             .astype(acc.dtype)                         # [P, k_cand]
         gi = all_gather(idx, axis_name)
-        gt = k2threshold_method(jnp.abs(gv).reshape(-1), min(k, P * k_cand),
+        # Python min when k is static (the "sort" method needs it so);
+        # a scheduled k is traced, and the schedule guarantees "bisect"
+        # (count-based, traced-k-capable)
+        k_pool = (min(k, P * k_cand) if isinstance(k, int)
+                  else jnp.minimum(k, P * k_cand))
+        gt = k2threshold_method(jnp.abs(gv).reshape(-1), k_pool,
                                 cfg.threshold_method,
                                 cfg.bisect_iters).astype(acc.dtype)
         keep = (jnp.abs(gv) >= gt) & (gi < n)

@@ -29,6 +29,19 @@ class OkTopkConfig:
     num_workers: int = 1       # data-parallel world size (mesh axis length)
     density: float = 0.02      # target k = ceil(density * n); reference VGG run uses 0.02
 
+    # Dynamic density schedule (reference get_current_density,
+    # VGG/allreducer.py:264-268: per-epoch density lists, shipped
+    # tuned-off). Sorted (start_step, density) pairs; the active density
+    # is the last pair whose start_step <= state.step. TPU-first reading:
+    # shapes must be static under jit, so the schedule changes the target
+    # k the threshold controller chases (a traced scalar from the step
+    # counter), while every fixed-capacity buffer stays sized by the MAX
+    # density = ``density`` (validated below). Requires the sort-free
+    # "bisect" threshold (count-based, traced-k-capable); ``lax.top_k``
+    # needs a static k. oktopk only — the topkA family's exact local
+    # top-k is itself a static-k sort.
+    density_schedule: Optional[Tuple[Tuple[int, float], ...]] = None
+
     # Cadences (reference VGG/allreducer.py:577-579; BERT uses 128/128/64).
     local_recompute_every: int = 32    # exact local top-k threshold recompute
     global_recompute_every: int = 32   # exact global top-k threshold recompute
@@ -127,7 +140,9 @@ class OkTopkConfig:
 
     @property
     def k(self) -> int:
-        """Target number of selected elements (k = density * n)."""
+        """Target number of selected elements (k = density * n). With a
+        density_schedule this is the MAX over the schedule (capacity
+        sizing); the per-step target is :func:`scheduled_k`."""
         return max(1, int(self.density * self.n))
 
     @property
@@ -163,6 +178,27 @@ class OkTopkConfig:
             raise ValueError(
                 f"wire_dtype must be 'float32' or 'bfloat16', "
                 f"got {self.wire_dtype!r}")
+        if self.density_schedule:
+            starts = [s for s, _ in self.density_schedule]
+            if starts != sorted(starts):
+                raise ValueError(
+                    f"density_schedule starts must be ascending: {starts}")
+            if starts[0] != 0:
+                raise ValueError(
+                    f"density_schedule must start at step 0 (got "
+                    f"{starts[0]}): every step needs an active pair — "
+                    "add an explicit (0, density) entry for the early "
+                    "phase")
+            worst = max(d for _, d in self.density_schedule)
+            if worst > self.density:
+                raise ValueError(
+                    f"density_schedule peaks at {worst} > density "
+                    f"{self.density}; capacities are sized by `density`, "
+                    "set it to the schedule's max")
+            if self.threshold_method != "bisect":
+                raise ValueError(
+                    "density_schedule needs threshold_method='bisect' "
+                    "(a traced target k; lax.top_k wants it static)")
 
     @property
     def wire_value_bytes(self) -> int:
@@ -176,6 +212,25 @@ class OkTopkConfig:
 
     def replace(self, **kw) -> "OkTopkConfig":
         return dataclasses.replace(self, **kw)
+
+
+def scheduled_k(cfg: OkTopkConfig, step):
+    """Per-step target k under ``cfg.density_schedule`` (a traced int32
+    scalar of ``step``), or the static ``cfg.k`` without one.
+
+    The reference looks its density up per epoch (get_current_density,
+    VGG/allreducer.py:264-268) and re-sizes its MPI buffers implicitly;
+    here the lookup is a tiny gather the step program traces once, and
+    buffers never re-size (see the density_schedule field note)."""
+    import jax.numpy as jnp
+
+    if not cfg.density_schedule:
+        return cfg.k
+    starts = jnp.asarray([s for s, _ in cfg.density_schedule], jnp.int32)
+    ks = jnp.asarray([max(1, int(d * cfg.n))
+                      for _, d in cfg.density_schedule], jnp.int32)
+    i = jnp.maximum(jnp.sum(step >= starts) - 1, 0)
+    return ks[i]
 
 
 @dataclasses.dataclass(frozen=True)

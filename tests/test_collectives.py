@@ -186,13 +186,74 @@ class TestOkTopk:
             _, state = step(grads, state)
             if i % 4 != 0:  # predicted-global steps
                 vols.append(float(state.last_volume[0]))
-        budget = 6.0 * 2 * k        # 6k (index,value) elements = 12k scalars
+        # STRICT reading of the paper's bound: 6k *scalars* total — the
+        # same interpretation bench.py and docs/PERF.md:18-23 hold the
+        # measured steady state to (62,914 at n=2^20, density 0.01)
+        budget = 6.0 * k
         # the paper's property is the steady-state *mean*, not the best step
         assert sum(vols) / len(vols) < budget, \
             f"mean volume {sum(vols)/len(vols):.0f} vs 6k budget {budget}"
         for v in vols:
             assert v < 2 * budget, f"volume {v} vs budget {budget}"
             assert v < 2.0 * n / 4, "not meaningfully sparser than dense"
+
+    def test_density_schedule_ramps_down(self, mesh8):
+        """Step-indexed density ladder (reference get_current_density,
+        VGG/allreducer.py:264-268): the scheduled target k is a traced
+        scalar the threshold controller chases, capacities stay at the
+        max density. Ramping 0.05 -> 0.01 at step 6 must cut the realised
+        global selection roughly 5x."""
+        rng = np.random.RandomState(13)
+        n = 4096
+        cfg = OkTopkConfig(n=n, num_workers=P, density=0.05,
+                           warmup_steps=0, local_recompute_every=1,
+                           global_recompute_every=1,
+                           density_schedule=((0, 0.05), (6, 0.01)))
+        step = build_allreduce_step("oktopk", cfg, mesh8, warmup=False)
+        state = batched_init_state(cfg)
+        base = rng.randn(P, n).astype(np.float32)
+        counts = []
+        for i in range(12):
+            grads = jnp.asarray(
+                base + 0.3 * rng.randn(P, n).astype(np.float32))
+            _, state = step(grads, state)
+            counts.append(float(state.last_global_count[0]))
+        early, late = np.mean(counts[1:5]), np.mean(counts[8:])
+        assert late < 0.5 * early, (early, late)
+        # capacity sizing and static-k sorts are guarded at config time
+        with pytest.raises(ValueError):
+            OkTopkConfig(n=n, density=0.01,
+                         density_schedule=((0, 0.05),))
+        with pytest.raises(ValueError):
+            OkTopkConfig(n=n, density=0.05, threshold_method="sort",
+                         density_schedule=((0, 0.01),))
+
+    @pytest.mark.slow
+    def test_comm_volume_below_6k_at_vgg_scale(self, mesh8):
+        """Same strict 6k-scalar budget at the headline model's size
+        (VGG-16, 14.7M params, density 0.02 — the reference VGG run,
+        VGG/vgg16_oktopk.sh) where the fixed-capacity buffers actually
+        stress: cap_pair/cap_gather/cap_exact are ~36k-147k elements here vs
+        ~10-40 in the small-n test above, so capacity-overflow clipping
+        and the controller's band behaviour are exercised at scale."""
+        rng = np.random.RandomState(23)
+        n = 14_700_000
+        cfg = OkTopkConfig(n=n, num_workers=P, density=0.02, warmup_steps=0,
+                           local_recompute_every=1, global_recompute_every=4)
+        k = cfg.k
+        step = build_allreduce_step("oktopk", cfg, mesh8, warmup=False)
+        state = batched_init_state(cfg)
+        base = rng.randn(P, n).astype(np.float32)
+        vols = []
+        for i in range(6):
+            grads = jnp.asarray(
+                base + 0.3 * rng.randn(P, n).astype(np.float32))
+            _, state = step(grads, state)
+            if i % 4 != 0:  # predicted-global steps
+                vols.append(float(state.last_volume[0]))
+        budget = 6.0 * k
+        assert sum(vols) / len(vols) < budget, \
+            f"mean volume {sum(vols)/len(vols):.0f} vs 6k budget {budget}"
 
     def test_repartition_preserves_invariant(self, mesh8):
         rng = np.random.RandomState(5)
