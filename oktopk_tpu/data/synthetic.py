@@ -47,14 +47,34 @@ def synthetic_batch(dnn: str, batch_size: int, rng: np.random.RandomState,
                 "mlm_labels": mlm,
                 "nsp_labels": rng.randint(0, 2, size=(batch_size,))
                 .astype(np.int32)}
-    if dnn == "lstman4":
+    if dnn.startswith("lstman4"):
+        # Tone-coded utterances: each character is rendered as ~8 frames of
+        # energy in its own 5-bin frequency band (29 chars * 5 <= 161 bins)
+        # over a noise floor. Random spectrograms with random labels carry
+        # no audio->text relation, so CTC loss curves on them are
+        # meaningless; a tone code gives the model a real alignment task —
+        # the CTC analogue of the bigram chain above and the linear teacher
+        # of teacher_iterator — so WER from the greedy decoder is a real
+        # learning signal (reference trains DeepSpeech on AN4 to WER,
+        # LSTM/dl_trainer.py:420-446, decoder VGG/decoder.py:23-197).
         f, t = 161, seq_len or 201
-        return {"spect": rng.randn(batch_size, f, t, 1).astype(np.float32),
-                "spect_lengths": np.full((batch_size,), t // 2, np.int32),
-                "labels": rng.randint(1, 29, size=(batch_size, 40))
-                .astype(np.int32),
-                "label_lengths": rng.randint(5, 20, size=(batch_size,))
-                .astype(np.int32)}
+        fpc = 8                           # frames per character
+        max_len = max(1, min(20, (t - 1) // fpc))
+        min_len = min(5, max_len)         # short seq_len: fewer chars fit
+        spect = (0.3 * rng.randn(batch_size, f, t, 1)).astype(np.float32)
+        label_lengths = rng.randint(min_len, max_len + 1,
+                                    size=(batch_size,)).astype(np.int32)
+        labels = np.zeros((batch_size, 40), np.int32)
+        for b in range(batch_size):
+            ln = int(label_lengths[b])
+            seq = rng.randint(1, 29, size=(ln,))
+            labels[b, :ln] = seq
+            for i, c in enumerate(seq):
+                spect[b, c * 5:c * 5 + 5, i * fpc:(i + 1) * fpc, 0] += 1.0
+        return {"spect": spect,
+                "spect_lengths": (label_lengths * fpc).astype(np.int32),
+                "labels": labels,
+                "label_lengths": label_lengths}
     if dnn == "mnistnet":
         return {"image": rng.randn(batch_size, 28, 28, 1).astype(np.float32),
                 "label": rng.randint(0, 10, size=(batch_size,))

@@ -59,6 +59,12 @@ MODELS: Dict[str, Callable[..., Tuple[Any, Callable]]] = {
     "lstman4": lambda **kw: (DeepSpeech(**kw),
                              lambda bs: jnp.zeros((bs, 161, 201, 1),
                                                   jnp.float32)),
+    # CPU-mesh-sized DeepSpeech (the CTC convergence probe, the role
+    # lstm_tiny/bert_tiny play for their families): same 2-conv frontend +
+    # summed-bidirectional stack, 2x128 instead of 5x800.
+    "lstman4_tiny": lambda **kw: (
+        DeepSpeech(**{"rnn_hidden": 128, "num_layers": 2, **kw}),
+        lambda bs: jnp.zeros((bs, 161, 201, 1), jnp.float32)),
     "bert_base": lambda **kw: (
         BertForPreTraining(BertConfig.base(**kw)), _tokens(128, 30522)),
     "bert_large": lambda **kw: (

@@ -1,6 +1,9 @@
 """Checkpoint evaluation driver (reference VGG/evaluate.py:20: load per-epoch
-checkpoints, run trainer.test; WER/CER for the speech workload via the
-decoder, VGG/dl_trainer.py:743-762).
+checkpoints, run trainer.test). For the speech workload (lstman4*) each
+eval batch is scored with real CTC loss plus greedy-decoded WER/CER
+(Trainer.eval_step -> utils.decoder.GreedyDecoder — the reference's test
+loop, VGG/dl_trainer.py:743-762), so the averaged metrics printed here
+include ``wer``/``cer``.
 
 Usage:
     python -m oktopk_tpu.train.evaluate --dnn vgg16 --dataset cifar10 \\

@@ -133,7 +133,7 @@ class Trainer:
             return self.model.init(rngs, batch["input_ids"],
                                    batch["token_type_ids"],
                                    batch["attention_mask"], train=False)
-        if self.cfg.dnn == "lstman4":
+        if self.cfg.dnn.startswith("lstman4"):
             return self.model.init(rngs, batch["spect"], train=False)
         return self.model.init(rngs, batch["image"], train=False)
 
@@ -151,7 +151,7 @@ class Trainer:
                     "attention_mask": jnp.ones((bs, t), jnp.int32),
                     "mlm_labels": jnp.full((bs, t), -1, jnp.int32),
                     "nsp_labels": jnp.zeros((bs,), jnp.int32)}
-        if dnn == "lstman4":
+        if dnn.startswith("lstman4"):
             return {"spect": jnp.zeros((bs, 161, 201, 1), jnp.float32),
                     "spect_lengths": jnp.full((bs,), 101, jnp.int32),
                     "labels": jnp.zeros((bs, 40), jnp.int32),
@@ -180,7 +180,7 @@ class Trainer:
             loss, aux = losses.bert_pretrain_loss(
                 mlm, nsp, batch["mlm_labels"], batch["nsp_labels"])
             return loss, (dict(mut), aux)
-        if dnn == "lstman4":
+        if dnn.startswith("lstman4"):
             logits, mut = self.model.apply(
                 variables, batch["spect"], train=True, mutable=mutable,
                 rngs=rngs)
@@ -326,9 +326,28 @@ class Trainer:
             loss, aux = losses.bert_pretrain_loss(
                 mlm, nsp, batch["mlm_labels"], batch["nsp_labels"])
             return {"loss": loss, **aux}
-        if dnn == "lstman4":
+        if dnn.startswith("lstman4"):
+            # real CTC loss + greedy-decoded WER/CER — the reference's test
+            # loop decodes every eval batch and averages word/char distances
+            # (VGG/dl_trainer.py:743-762, decoder at VGG/decoder.py:23-197)
+            from oktopk_tpu.data.audio import AN4_LABELS
+            from oktopk_tpu.utils.decoder import GreedyDecoder
+
             logits = self.model.apply(variables, batch["spect"], train=False)
-            return {"loss": jnp.asarray(0.0)}
+            frames = logits.shape[1]
+            frame_len = jnp.minimum(batch["spect_lengths"], frames)
+            loss = losses.ctc_loss(logits, frame_len, batch["labels"],
+                                   batch["label_lengths"])
+            dec = GreedyDecoder(AN4_LABELS)
+            hyps = dec.decode(np.asarray(logits), np.asarray(frame_len))
+            labs = np.asarray(batch["labels"])
+            lens = np.asarray(batch["label_lengths"])
+            refs = ["".join(AN4_LABELS[c] for c in labs[b, : lens[b]])
+                    for b in range(labs.shape[0])]
+            wer = float(np.mean([dec.wer(h, r) for h, r in zip(hyps, refs)]))
+            cer = float(np.mean([dec.cer(h, r) for h, r in zip(hyps, refs)]))
+            return {"loss": loss, "wer": jnp.asarray(wer),
+                    "cer": jnp.asarray(cer)}
         logits = self.model.apply(variables, batch["image"], train=False)
         loss = losses.softmax_cross_entropy(logits, batch["label"])
         acc = jnp.mean(

@@ -84,6 +84,22 @@ class TestWorkloads:
         assert np.isfinite(float(m["loss"]))
         assert "mlm_loss" not in m or np.isfinite(float(m.get("mlm_loss", 0)))
 
+    def test_ctc_lstman4_tiny_oktopk(self, mesh4):
+        """CTC/speech slice end-to-end: real optax.ctc_loss training on
+        the tone-coded synthetic AN4 batches (reference trains DeepSpeech
+        on AN4, LSTM/dl_trainer.py:420-446), with the reference LSTM
+        driver's gradient clipping."""
+        cfg = TrainConfig(dnn="lstman4_tiny", dataset="an4", batch_size=2,
+                          lr=3e-4, compressor="oktopk", density=0.05,
+                          grad_clip=400.0)
+        tr = Trainer(cfg, mesh=mesh4, warmup=False)
+        it = synthetic_iterator("lstman4_tiny", 8, seed=4, seq_len=101)
+        m = None
+        for _ in range(2):
+            m = tr.train_step(next(it))
+        assert np.isfinite(float(m["loss"]))
+        assert float(m["comm_volume"]) > 0
+
     def test_grad_accumulation(self, mesh4):
         cfg = TrainConfig(dnn="mnistnet", dataset="mnist", batch_size=8,
                           lr=0.05, compressor="gaussiank", density=0.1,
@@ -102,6 +118,20 @@ class TestEval:
         it = synthetic_iterator("mnistnet", 16, seed=5)
         m = tr.eval_step(next(it))
         assert 0.0 <= float(m["accuracy"]) <= 1.0
+
+    def test_eval_speech_wer(self, mesh4):
+        """The lstman4 eval path computes real CTC loss + greedy-decoded
+        WER/CER (the reference's test loop, VGG/dl_trainer.py:743-762) —
+        not the constant 0.0 it returned before round 4."""
+        cfg = TrainConfig(dnn="lstman4_tiny", dataset="an4", batch_size=2,
+                          compressor="dense")
+        tr = Trainer(cfg, mesh=mesh4, warmup=False)
+        it = synthetic_iterator("lstman4_tiny", 4, seed=6, seq_len=101)
+        m = tr.eval_step(next(it))
+        assert np.isfinite(float(m["loss"])) and float(m["loss"]) > 0
+        assert 0.0 <= float(m["cer"]) <= float(m["wer"]) + 1e-6
+        # an untrained model cannot beat chance on tone-coded utterances
+        assert float(m["wer"]) > 0.5
 
 
 class TestBucketedAllreduce:
