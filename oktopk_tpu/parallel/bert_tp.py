@@ -145,9 +145,16 @@ def bert_tp_loss(tp_layers, shared, batch, cfg: BertConfig,
                  axis_name: str = "model"):
     """Replicated-batch MLM+NSP loss with tensor-parallel layers (inside
     shard_map; ``tp_layers`` leaves are this rank's [1, ...] shard rows)."""
-    import optax
-
     tp_local = jax.tree.map(lambda x: x[0], tp_layers)
+    return tp_loss_local(tp_local, shared, batch, cfg, axis_name)
+
+
+def tp_loss_local(tp_local, shared, batch, cfg: BertConfig,
+                  axis_name: str = "model"):
+    """As :func:`bert_tp_loss` but with the leading shard axis already
+    stripped (``tp_local`` leaves are this rank's bare shard) — the form
+    the composed dp x tp step consumes."""
+    import optax
     ids = batch["input_ids"]
     B, T = ids.shape
     emb = shared["embeddings"]
@@ -181,12 +188,19 @@ def bert_tp_loss(tp_layers, shared, batch, cfg: BertConfig,
     return mlm_loss + nsp_loss
 
 
-def make_tp_mesh(num_shards: int, devices=None) -> Mesh:
+def make_tp_mesh(num_shards: int, devices=None, data_size: int = 1) -> Mesh:
+    """1-D ("model",) mesh, or 2-D ("data", "model") when
+    ``data_size > 1`` (the composed dp x tp form)."""
     import numpy as np
     devices = list(devices if devices is not None else jax.devices())
-    if len(devices) < num_shards:
-        raise ValueError(f"tensor parallelism needs {num_shards} devices, "
+    need = num_shards * data_size
+    if len(devices) < need:
+        raise ValueError(f"tensor parallelism needs {need} devices, "
                          f"have {len(devices)}")
+    if data_size > 1:
+        return Mesh(np.asarray(devices[:need]).reshape(data_size,
+                                                       num_shards),
+                    ("data", "model"))
     return Mesh(np.asarray(devices[:num_shards]), ("model",))
 
 
@@ -200,3 +214,164 @@ def build_tp_loss(cfg: BertConfig, mesh: Mesh, axis_name: str = "model"):
                            in_specs=(P(axis_name), P(), P()),
                            out_specs=P())
     return jax.jit(mapped)
+
+
+def build_tp_train_step(cfg: BertConfig, mesh: Mesh, optimizer,
+                        axis_name: str = "model"):
+    """jit ``(tp_stack, shared, opt_tp, opt_sh, batch) -> (tp_stack,
+    shared, opt_tp, opt_sh, loss)`` on the ("model",) mesh.
+
+    Grads wrt the replicated ``shared`` tree need no explicit model-axis
+    psum: the loss is model-invariant after the layer psums, and the AD
+    transpose of the invariant->varying promotion already completes the
+    cotangent over ``model`` (an explicit psum would overcount by the
+    shard count — the same hazard the pipeline step documents,
+    bert_pipeline.py:294-299). The two optimizer states mirror the two
+    param trees: ``opt_tp`` sharded over ``model``, ``opt_sh``
+    replicated — elementwise optimizers (SGD/Adam) act shard-locally, so
+    the sharded moments are exactly the merged moments re-split."""
+    def shard_fn(tp_layers, shared, opt_tp, opt_sh, batch):
+        row = lambda t: jax.tree.map(lambda x: x[0], t)
+        lead = lambda t: jax.tree.map(lambda x: x[None], t)
+        tp_local, opt_tp_l = row(tp_layers), row(opt_tp)
+
+        loss, (g_tp, g_sh) = jax.value_and_grad(
+            tp_loss_local, argnums=(0, 1))(tp_local, shared, batch, cfg,
+                                           axis_name)
+        upd_t, opt_tp_l = optimizer.update(g_tp, opt_tp_l, tp_local)
+        tp_local = jax.tree.map(jnp.add, tp_local, upd_t)
+        upd_s, opt_sh = optimizer.update(g_sh, opt_sh, shared)
+        shared = jax.tree.map(jnp.add, shared, upd_s)
+        return lead(tp_local), shared, lead(opt_tp_l), opt_sh, loss
+
+    m = P(axis_name)
+    mapped = jax.shard_map(shard_fn, mesh=mesh,
+                           in_specs=(m, P(), m, P(), P()),
+                           out_specs=(m, P(), m, P(), P()),
+                           check_vma=True)
+    return jax.jit(mapped, donate_argnums=(0, 1, 2, 3))
+
+
+def init_tp_opt_states(optimizer, tp_layers, shared):
+    """Optimizer states for :func:`build_tp_train_step`: ``opt_tp`` is
+    initialised per shard row (vmap over the leading [P] axis, so sharded
+    moments line up with sharded params), ``opt_sh`` once."""
+    return (jax.vmap(optimizer.init)(tp_layers), optimizer.init(shared))
+
+
+def init_tp_sparse_states(tp_layers, shared, algo_cfg, dp: int):
+    """Per-(data rank, model rank) sparse states for the composed step.
+
+    Returns ``(tp_sstate, shared_sstate)``: tp states stacked
+    [dp, P, ...] (sharded over data x model), shared state stacked
+    [dp, ...]. Requires uniform shard sizes (split_tp's equal splits
+    guarantee it)."""
+    from oktopk_tpu.collectives.state import init_state
+
+    leaves = jax.tree.leaves(tp_layers)
+    tp_shards = leaves[0].shape[0]
+    sizes = {int(sum(x[i].size for x in leaves)) for i in range(tp_shards)}
+    assert len(sizes) == 1, f"non-uniform tp shard sizes {sizes}"
+    n_tp = sizes.pop()
+    n_shared = int(sum(x.size for x in jax.tree.leaves(shared)))
+
+    def stack(s, lead):
+        return jax.tree.map(
+            lambda x: jnp.broadcast_to(x, lead + x.shape), s)
+
+    return (stack(init_state(algo_cfg.replace(n=n_tp, num_workers=dp)),
+                  (dp, tp_shards)),
+            stack(init_state(algo_cfg.replace(n=n_shared, num_workers=dp)),
+                  (dp,)))
+
+
+def build_tp_sparse_train_step(cfg: BertConfig, mesh: Mesh, optimizer,
+                               algo_cfg, compressor: str = "oktopk",
+                               warmup: bool = True,
+                               axis_name: str = "model",
+                               data_axis: str = "data"):
+    """Sparse data parallelism composed with tensor parallelism: jit
+    ``((tp_stack, shared), (tp_ss, shared_ss), (opt_tp, opt_sh), batch)
+    -> (...)`` on the (data, model) mesh — the data x model cell of the
+    composition matrix (README/PERF.md), previously loss-only.
+
+    Composition: each (data, model) rank computes its shard's gradient
+    through the TP loss (psums over ``model`` only), then runs the sparse
+    collective over ``data`` on TWO separate flat vectors with separate
+    SparseStates — its tp-shard gradient, and the shared (replicated)
+    gradient. The split is load-bearing: compressing one mixed vector
+    would let per-model-rank thresholds (driven by the differing tp
+    shards) select *different* shared elements on different model ranks,
+    and the replicated shared params would silently diverge. With the
+    shared vector compressed on its own, its inputs are model-invariant,
+    the deterministic algorithm returns model-invariant results, and
+    replicas stay bitwise identical — same argument as the pipeline
+    composition's shared bucket (bert_pipeline.py:231-348).
+
+    Layouts: tp_stack / tp_ss / opt_tp leaves [dp, P, ...] sharded
+    (data, model); shared / shared_ss / opt_sh leaves [dp, ...] sharded
+    (data); batch [dp*b, T] split over data, replicated over model."""
+    from oktopk_tpu.collectives.registry import get_algorithm
+    from oktopk_tpu.ops.compaction import resolve_use_pallas
+    from oktopk_tpu.utils.flatten import flatten_tree, unflatten_tree
+
+    algo_cfg = resolve_use_pallas(algo_cfg, mesh)
+    algo_cfg = algo_cfg.replace(num_workers=int(mesh.shape[data_axis]))
+    algo = get_algorithm(compressor, warmup=warmup)
+
+    def shard_fn(params, sstates, opt_states, batch):
+        tp_stack, shared = params
+        tp_ss, shared_ss = sstates
+        opt_tp, opt_sh = opt_states
+        row2 = lambda t: jax.tree.map(lambda x: x[0, 0], t)
+        row = lambda t: jax.tree.map(lambda x: x[0], t)
+        my_tp, shared_l = row2(tp_stack), row(shared)
+        my_tp_ss, my_sh_ss = row2(tp_ss), row(shared_ss)
+        my_opt_tp, my_opt_sh = row2(opt_tp), row(opt_sh)
+
+        loss, (g_tp, g_sh) = jax.value_and_grad(
+            tp_loss_local, argnums=(0, 1))(my_tp, shared_l, batch, cfg,
+                                           axis_name)
+
+        cfg_tp = algo_cfg.replace(
+            n=int(sum(x.size for x in jax.tree.leaves(g_tp))))
+        cfg_sh = algo_cfg.replace(
+            n=int(sum(x.size for x in jax.tree.leaves(g_sh))))
+        flat_t, leaves_t, td_t = flatten_tree(g_tp)
+        red_t, my_tp_ss = algo(flat_t, my_tp_ss, cfg_tp, data_axis)
+        g_tp = unflatten_tree(red_t, leaves_t, td_t)
+        flat_h, leaves_h, td_h = flatten_tree(g_sh)
+        red_h, my_sh_ss = algo(flat_h, my_sh_ss, cfg_sh, data_axis)
+        g_sh = unflatten_tree(red_h, leaves_h, td_h)
+
+        upd_t, my_opt_tp = optimizer.update(g_tp, my_opt_tp, my_tp)
+        my_tp = jax.tree.map(jnp.add, my_tp, upd_t)
+        upd_s, my_opt_sh = optimizer.update(g_sh, my_opt_sh, shared_l)
+        shared_l = jax.tree.map(jnp.add, shared_l, upd_s)
+
+        lead2 = lambda t: jax.tree.map(lambda x: x[None, None], t)
+        lead = lambda t: jax.tree.map(lambda x: x[None], t)
+        vol = my_tp_ss.last_volume + my_sh_ss.last_volume
+
+        def pmean_varying(x):
+            ax = tuple(a for a in (data_axis, axis_name)
+                       if a in jax.typeof(x).vma)
+            return lax.pmean(x, ax) if ax else x
+
+        metrics = {"loss": pmean_varying(loss),
+                   "comm_volume": pmean_varying(vol)}
+        return ((lead2(my_tp), lead(shared_l)),
+                (lead2(my_tp_ss), lead(my_sh_ss)),
+                (lead2(my_opt_tp), lead(my_opt_sh)), metrics)
+
+    dm = P(data_axis, axis_name)
+    d = P(data_axis)
+    batch_specs = {k: d for k in ("input_ids", "token_type_ids",
+                                  "attention_mask", "mlm_labels",
+                                  "nsp_labels")}
+    mapped = jax.shard_map(
+        shard_fn, mesh=mesh,
+        in_specs=((dm, d), (dm, d), (dm, d), batch_specs),
+        out_specs=((dm, d), (dm, d), (dm, d), P()),
+        check_vma=True)
+    return jax.jit(mapped, donate_argnums=(0, 1, 2))
