@@ -1,0 +1,32 @@
+#!/usr/bin/env bash
+# One live-relay window capture: everything the perf evidence needs from
+# the real chip, in priority order, each with its own deadline so a relay
+# flap mid-way keeps earlier artifacts (the tunnel dies and returns
+# unpredictably — poll utils/tunnel.relay_listening before running).
+#
+#   1. Mosaic kernel-parity regression net (tests/test_tpu_hw.py) -> also
+#      stamps logs/tpu_hw_status.json (date+commit) via conftest.
+#   2. bench.py end-to-end -> logs/bench_capture.json (volume + step
+#      times incl. the Pallas kernel path + bs-256 MFU probes).
+#
+# Usage: bash scripts/chip_capture.sh [deadline_s_per_phase]
+set -u
+cd "$(dirname "$0")/.."
+DEADLINE="${1:-1500}"
+export JAX_COMPILATION_CACHE_DIR="${JAX_COMPILATION_CACHE_DIR:-/tmp/oktopk_jax_cache}"
+mkdir -p logs
+
+echo "[chip] phase 1: hardware kernel-parity tests (deadline ${DEADLINE}s)"
+timeout "$DEADLINE" env OKTOPK_TPU_HW=1 JAX_PLATFORMS=axon \
+    python -m pytest tests/test_tpu_hw.py -q 2>&1 | tail -5
+echo "[chip] tpu_hw_status: $(cat logs/tpu_hw_status.json 2>/dev/null || echo none)"
+
+echo "[chip] phase 2: bench.py (deadline ${DEADLINE}s per step-probe attempt)"
+# outer timeout > bench.py's own worst case: volume probe (internal
+# timeout 1800 s) + 2 step-probe attempts x DEADLINE + slack — an outer
+# kill before the final record line would discard every number bench.py
+# already holds (its subprocess output is not on OUR stdout)
+OKTOPK_BENCH_STEP_DEADLINE="$DEADLINE" timeout $((1800 + 2 * DEADLINE + 300)) \
+    python bench.py > logs/bench_capture.json 2> logs/bench_capture.err
+tail -2 logs/bench_capture.err
+cat logs/bench_capture.json
