@@ -185,7 +185,14 @@ def step_time_probe(iters=10):
         # through the tunnel), the step time just measured still reaches
         # the record via the partial stdout
         print("STEP_PROBE " + json.dumps(out), flush=True)
-        if comp == "dense" and dt == "float32" and bs not in flops_by_bs:
+        # in-loop cost analysis only for the bs-16 shape (already
+        # compiled by the dense timing). The bs-256 analysis is a FRESH
+        # remote lowering+compile (minutes through the tunnel) that must
+        # not sit between the dense_bs256 and oktopk_bs256 timings — it
+        # runs after the loop so a deadline kill costs the MFU ratio,
+        # never a headline step time.
+        if (bs == 16 and comp == "dense" and dt == "float32"
+                and bs not in flops_by_bs):
             try:
                 rng_key = jax.random.PRNGKey(0)
                 cost = model_complexity(
@@ -193,8 +200,7 @@ def step_time_probe(iters=10):
                     trainer.state, batch, rng_key)
                 if cost["flops"] > 0:
                     flops_by_bs[bs] = cost["flops"]
-                    out["flops_per_step" if bs == 16
-                        else f"flops_per_step_bs{bs}"] = cost["flops"]
+                    out["flops_per_step"] = cost["flops"]
             except Exception as e:
                 print(f"[bench] cost analysis unavailable: {e!r}",
                       file=sys.stderr)
@@ -212,6 +218,38 @@ def step_time_probe(iters=10):
             out["peak_flops_assumed"] = peak   # v5e fp32 unless overridden
             out[f"mfu_{name}"] = (flops_by_bs[bs]
                                   / (out[f"{name}_ms"] / 1e3) / peak)
+        print("STEP_PROBE " + json.dumps(out), flush=True)
+
+    # bs-256 MFU, after every timing is safe: a real cost analysis (one
+    # fresh compile) with a linear-scaling fallback — VGG step flops are
+    # conv/matmul-dominated and exactly proportional to batch, the
+    # remainder (optimizer/selection) is batch-independent and small
+    if "dense_bs256_ms" in out and 16 in flops_by_bs:
+        try:
+            cfg = TrainConfig(dnn="vgg16", dataset="cifar10",
+                              batch_size=256, lr=0.1, compressor="dense",
+                              density=0.02, num_workers=1)
+            tr = Trainer(cfg, mesh=mesh, warmup=False)
+            cost = model_complexity(
+                lambda s, b, r: tr.step_fn(s, b, r),
+                tr.state, batches[256], jax.random.PRNGKey(0))
+            if cost["flops"] > 0:
+                flops_by_bs[256] = cost["flops"]
+        except Exception as e:
+            print(f"[bench] bs-256 cost analysis unavailable: {e!r}",
+                  file=sys.stderr)
+        if 256 not in flops_by_bs:
+            flops_by_bs[256] = flops_by_bs[16] * 16.0
+            out["flops_per_step_bs256_scaled"] = True
+        out["flops_per_step_bs256"] = flops_by_bs[256]
+        if dev.platform != "cpu" or "OKTOPK_PEAK_FLOPS" in os.environ:
+            peak = float(os.environ.get("OKTOPK_PEAK_FLOPS",
+                                        DEFAULT_PEAK_FLOPS))
+            out["peak_flops_assumed"] = peak
+            for nm in ("dense_bs256", "oktopk_bs256"):
+                if f"{nm}_ms" in out:
+                    out[f"mfu_{nm}"] = (flops_by_bs[256]
+                                        / (out[f"{nm}_ms"] / 1e3) / peak)
         print("STEP_PROBE " + json.dumps(out), flush=True)
     print(f"[bench] {out}", file=sys.stderr)
     return out
@@ -336,7 +374,7 @@ def main():
                 "oktopk_pallas_failed", "oktopk_bs256_pallas_failed",
                 "oktopk_b4_pallas_failed",
                 "flops_per_step", "flops_per_step_bs256",
-                "peak_flops_assumed",
+                "flops_per_step_bs256_scaled", "peak_flops_assumed",
                 "mfu_dense", "mfu_oktopk", "mfu_dense_bs256",
                 "mfu_oktopk_bs256"):
         if key in steps:

@@ -46,6 +46,10 @@ VARIANTS = {
     "bandk":   ({}, {"band_lo": 1.0, "band_hi": 1.5, "band_hi_global": 1.5}),
     "drift05": ({}, {"drift_ema": 0.5}),
     "rec8":    ({}, {"local_recompute_every": 8, "global_recompute_every": 8}),
+    # the two knobs that moved the needle, combined (warmup is free —
+    # same steady-state volume; d016 is the iso-volume point vs topkA)
+    "w400d016": ({"density": 0.16}, {"warmup_steps": 400}),
+    "w400d010": ({"density": 0.10}, {"warmup_steps": 400}),
 }
 
 

@@ -28,5 +28,10 @@ echo "[chip] phase 2: bench.py (deadline ${DEADLINE}s per step-probe attempt)"
 # already holds (its subprocess output is not on OUR stdout)
 OKTOPK_BENCH_STEP_DEADLINE="$DEADLINE" timeout $((1800 + 2 * DEADLINE + 300)) \
     python bench.py > logs/bench_capture.json 2> logs/bench_capture.err
+RC=$?
 tail -2 logs/bench_capture.err
 cat logs/bench_capture.json
+if [ "$RC" -ne 0 ] || [ ! -s logs/bench_capture.json ]; then
+    echo "[chip] bench FAILED (rc=$RC, json $(wc -c < logs/bench_capture.json 2>/dev/null || echo 0) bytes)"
+    exit 1
+fi

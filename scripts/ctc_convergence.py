@@ -47,7 +47,8 @@ def run_one(comp: str, steps: int, mesh, density: float, lr: float,
                       algo_cfg=OkTopkConfig(warmup_steps=warmup_steps))
     P = trainer.cfg.num_workers
     it = finite_pool_iterator("lstman4_tiny", batch_size * P,
-                              num_examples=128, seed=7, seq_len=SEQ_LEN)
+                              num_examples=max(128, batch_size * P),
+                              seed=7, seq_len=SEQ_LEN)
     eval_batch = next(it)
 
     path = os.path.join(out_dir, f"lstman4_tiny_{comp}.jsonl")
@@ -81,6 +82,8 @@ def run_one(comp: str, steps: int, mesh, density: float, lr: float,
 def main():
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--steps", type=int, default=240)
+    p.add_argument("--batch-size", type=int, default=4,
+                   help="per-worker examples per step")
     p.add_argument("--compressors", default="dense,oktopk,topkA")
     p.add_argument("--workers", type=int, default=8)
     p.add_argument("--density", type=float, default=0.05)
@@ -104,7 +107,8 @@ def main():
     os.makedirs(args.out, exist_ok=True)
     for comp in args.compressors.split(","):
         run_one(comp, args.steps, mesh, args.density, args.lr,
-                args.grad_clip, args.warmup_steps, args.out)
+                args.grad_clip, args.warmup_steps, args.out,
+                batch_size=args.batch_size)
 
 
 if __name__ == "__main__":

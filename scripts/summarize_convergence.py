@@ -1,0 +1,93 @@
+"""Render the convergence/ablation evidence tables from the JSONL logs.
+
+The tables in docs/PERF.md (per-workload five-algorithm comparisons, the
+LSTM ablation) are derived artifacts; this prints them from
+logs/convergence/*.jsonl and logs/ablation/*.jsonl so a reader can
+regenerate every number (the reproducibility analogue of the reference's
+accuracy-log runs, VGG/dl_trainer.py:606-616).
+
+Usage: python scripts/summarize_convergence.py [--dir logs/convergence]
+       python scripts/summarize_convergence.py --dir logs/ablation
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import statistics
+
+
+def load(path):
+    rows = []
+    for line in open(path):
+        try:
+            rows.append(json.loads(line))
+        except ValueError:
+            pass   # deadline-killed runs can truncate the last line
+    if not rows:
+        return None, []
+    return rows[0], rows[1:]
+
+
+def summarize(path):
+    hdr, rows = load(path)
+    if hdr is None or not rows:
+        return None
+    final = rows[-1]
+    evals = [(r["step"], r["eval_loss"]) for r in rows if "eval_loss" in r]
+    best = min(evals, key=lambda t: t[1]) if evals else (None, None)
+    # steady-state sparse-phase volume: past any warmup, past controller
+    # settling — the last 60% of steps
+    cut = hdr["steps"] * 0.4
+    vols = [r["comm_volume"] for r in rows if r["step"] > cut]
+    wers = [(r["step"], r["eval_wer"]) for r in rows if "eval_wer" in r]
+    out = {
+        "model": hdr["model"],
+        "compressor": hdr.get("variant") or hdr["compressor"],
+        "final_train_loss": final["loss"],
+        "best_eval_loss": best[1],
+        "best_eval_step": best[0],
+        "mean_volume": statistics.mean(vols) if vols else None,
+    }
+    if wers:
+        out["final_eval_wer"] = wers[-1][1]
+        out["best_eval_wer"] = min(w for _, w in wers)
+    return out
+
+
+def main():
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--dir", default="logs/convergence")
+    p.add_argument("--model", default=None,
+                   help="filter to one model prefix")
+    args = p.parse_args()
+
+    groups = {}
+    for path in sorted(glob.glob(os.path.join(args.dir, "*.jsonl"))):
+        s = summarize(path)
+        if s is None:
+            continue
+        if args.model and not s["model"].startswith(args.model):
+            continue
+        groups.setdefault(s["model"], []).append(s)
+
+    for model, rows in groups.items():
+        print(f"\n== {model} ==")
+        cols = ["compressor", "final_train_loss", "best_eval_loss",
+                "mean_volume"]
+        if any("final_eval_wer" in r for r in rows):
+            cols += ["final_eval_wer", "best_eval_wer"]
+        print(" | ".join(f"{c:>16}" for c in cols))
+        for r in sorted(rows, key=lambda r: (r["mean_volume"] or 0)):
+            cells = []
+            for c in cols:
+                v = r.get(c)
+                cells.append(f"{v:>16.4f}" if isinstance(v, float)
+                             else f"{str(v):>16}")
+            print(" | ".join(cells))
+
+
+if __name__ == "__main__":
+    main()
