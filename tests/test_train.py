@@ -119,6 +119,30 @@ class TestEval:
         m = tr.eval_step(next(it))
         assert 0.0 <= float(m["accuracy"]) <= 1.0
 
+    def test_evaluate_driver_prints_wer(self, mesh4, tmp_path, caplog):
+        """The checkpoint-evaluation driver end-to-end on the speech
+        workload: save a lstman4_tiny checkpoint, run evaluate.main, and
+        require wer/cer among the averaged metrics it logs (the
+        reference's per-epoch WER evaluation, VGG/evaluate.py:20 +
+        dl_trainer.py:743-762)."""
+        import logging
+
+        from oktopk_tpu.train import evaluate
+        from oktopk_tpu.train.checkpoint import save_checkpoint
+
+        cfg = TrainConfig(dnn="lstman4_tiny", dataset="an4", batch_size=2,
+                          compressor="dense")
+        tr = Trainer(cfg, mesh=mesh4, warmup=False)
+        save_checkpoint(str(tmp_path), tr.state, step=1)
+        with caplog.at_level(logging.INFO, logger="oktopk_tpu.eval"):
+            rc = evaluate.main(["--dnn", "lstman4_tiny", "--dataset", "an4",
+                                "--ckpt", str(tmp_path),
+                                "--batch-size", "2", "--num-batches", "2"])
+        assert rc == 0
+        logged = {r.message.split(":")[0] for r in caplog.records
+                  if ":" in r.message}
+        assert "wer" in logged and "cer" in logged, caplog.text
+
     def test_eval_speech_wer(self, mesh4):
         """The lstman4 eval path computes real CTC loss + greedy-decoded
         WER/CER (the reference's test loop, VGG/dl_trainer.py:743-762) —
