@@ -22,6 +22,14 @@ def hardtanh(x, lo=0.0, hi=20.0):
     return jnp.clip(x, lo, hi)
 
 
+# Net time-axis downsampling of the conv frontend: the first conv strides
+# time by 2, the second by 1 (kernel 11, padding (5,5): T -> ceil(T/2)).
+# Length metadata from the loaders (input-spectrogram frames) must be
+# divided by this before reaching ctc_loss / the decoder, exactly as the
+# reference scales lengths by its frontend stride.
+CONV_TIME_STRIDE = 2
+
+
 class BatchRNN(nn.Module):
     """Bidirectional LSTM with summed directions + preceding BatchNorm
     (reference lstm_models.py:83-106)."""

@@ -41,6 +41,17 @@ CNN_DNNS = {"vgg16", "vgg19", "resnet20", "resnet56", "resnet110",
             "resnet50", "alexnet", "mnistnet"}
 
 
+def _ctc_frame_len(spect_lengths):
+    """Input-spectrogram-frame lengths (what data/audio.py and
+    data/synthetic.py emit) -> output-logit-frame units for ctc_loss and
+    the greedy decoder: the conv frontend downsamples time by
+    CONV_TIME_STRIDE (the reference likewise divides loader lengths by its
+    frontend stride before warpctc, VGG/dl_trainer.py:743)."""
+    from oktopk_tpu.models.deepspeech import CONV_TIME_STRIDE
+    s = CONV_TIME_STRIDE
+    return (spect_lengths + s - 1) // s
+
+
 class Trainer:
     """End-to-end distributed trainer over a data-parallel mesh."""
 
@@ -153,7 +164,7 @@ class Trainer:
                     "nsp_labels": jnp.zeros((bs,), jnp.int32)}
         if dnn.startswith("lstman4"):
             return {"spect": jnp.zeros((bs, 161, 201, 1), jnp.float32),
-                    "spect_lengths": jnp.full((bs,), 101, jnp.int32),
+                    "spect_lengths": jnp.full((bs,), 201, jnp.int32),
                     "labels": jnp.zeros((bs, 40), jnp.int32),
                     "label_lengths": jnp.full((bs,), 10, jnp.int32)}
         img = self.example_fn(bs)
@@ -185,7 +196,8 @@ class Trainer:
                 variables, batch["spect"], train=True, mutable=mutable,
                 rngs=rngs)
             frames = logits.shape[1]
-            frame_len = jnp.minimum(batch["spect_lengths"], frames)
+            frame_len = jnp.minimum(_ctc_frame_len(batch["spect_lengths"]),
+                                    frames)
             loss = losses.ctc_loss(logits, frame_len, batch["labels"],
                                    batch["label_lengths"])
             return loss, (dict(mut), {})
@@ -335,7 +347,8 @@ class Trainer:
 
             logits = self.model.apply(variables, batch["spect"], train=False)
             frames = logits.shape[1]
-            frame_len = jnp.minimum(batch["spect_lengths"], frames)
+            frame_len = jnp.minimum(_ctc_frame_len(batch["spect_lengths"]),
+                                    frames)
             loss = losses.ctc_loss(logits, frame_len, batch["labels"],
                                    batch["label_lengths"])
             dec = GreedyDecoder(AN4_LABELS)
