@@ -14,7 +14,9 @@ set -u
 cd "$(dirname "$0")/.."
 MAX_S="${1:-39600}"      # default 11 h
 POLL_S="${2:-45}"
-PORT="${OKTOPK_RELAY_PORT:-8113}"
+# single source of truth for the port is utils/tunnel.py (which itself
+# honors OKTOPK_RELAY_PORT); 8113 only if python is unusable
+PORT="$(python -c 'from oktopk_tpu.utils.tunnel import relay_port; print(relay_port())' 2>/dev/null || echo 8113)"
 LOG=logs/relay_watch.log
 mkdir -p logs
 echo "[watch] armed $(date -u +%FT%TZ) port=$PORT poll=${POLL_S}s max=${MAX_S}s" >> "$LOG"
