@@ -60,6 +60,17 @@ def gtopk(grad: jnp.ndarray, state: SparseState, cfg: OkTopkConfig,
         vals, idx = exact_topk(merged, k)
         d <<= 1
 
+    # Merge losers return to error feedback: the reference's caller keeps
+    # every originally-selected value whose index did NOT survive the
+    # global re-selection (``included_indexes`` from
+    # VGG/allreducer.py:171-172, consumed by ``add_residuals`` at
+    # :1406-1411 — residual clears only at selected-AND-won slots).
+    # Dropping them loses ~(P-1)/P of the selected gradient mass per step
+    # and stalls convergence (observed: mnistnet stuck at chance).
+    winner_mask = jnp.zeros((n,), bool).at[idx].set(True)
+    lost = sel_mask & ~winner_mask
+    residual = jnp.where(lost, acc, residual)
+
     result = scatter_sparse(n, vals, idx) / P
     vol = 4.0 * k * rounds
     return result, bump(state, volume=vol, residual=residual,

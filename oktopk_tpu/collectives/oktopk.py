@@ -51,8 +51,20 @@ from oktopk_tpu.collectives.wire import (
 )
 
 
+def _target_k(k, n: int, factor: float):
+    """The controller setpoint ``factor * k`` as a selection count —
+    python int for a static k (the "sort" threshold method needs it
+    static), traced otherwise. Full-density operation (k == n) must stay
+    exactly dense, so the sub-k setpoint applies only when genuinely
+    sparse."""
+    if isinstance(k, int):
+        return k if k >= n else max(1, int(round(factor * k)))
+    kk = jnp.maximum(1, jnp.round(factor * k)).astype(jnp.int32)
+    return jnp.where(k >= n, k, kk)
+
+
 def _newton_adapt(thresh, count, count_probe, k, cfg: OkTopkConfig,
-                  band_hi=None):
+                  band_hi=None, target=None):
     """Threshold feedback toward the [band_lo*k, band_hi*k] count band.
 
     The reference nudges +-1.2% per step (VGG/allreducer.py:696-699,
@@ -73,7 +85,9 @@ def _newton_adapt(thresh, count, count_probe, k, cfg: OkTopkConfig,
     slope = (jnp.log(cp) - jnp.log(c)) / jnp.log(cfg.probe_ratio)
     exponent = jnp.clip(-1.0 / jnp.minimum(slope, -0.5),
                         cfg.newton_exp_lo, cfg.newton_exp_hi)
-    corr = (c / k) ** exponent
+    # corrections aim at the setpoint (<= k); the dead zone stays defined
+    # by the reference band around k, so in-band counts are never touched
+    corr = (c / (k if target is None else target)) ** exponent
     corr = jnp.clip(corr, 1.0 / cfg.adapt_max_step, cfg.adapt_max_step)
     hi = cfg.band_hi if band_hi is None else band_hi
     in_band = (count >= cfg.band_lo * k) & (count <= hi * k)
@@ -141,7 +155,13 @@ def oktopk(grad: jnp.ndarray, state: SparseState, cfg: OkTopkConfig,
     prev_lt = state.local_threshold
 
     def lt_exact():
-        lt_new = k2threshold_method(abs_acc, k, cfg.threshold_method,
+        # exact recompute lands the count at the local setpoint (<= k,
+        # inside the reference band) rather than exactly k: phase-(a)
+        # volume is 4*count*(P-1)/P, so the setpoint directly buys budget
+        # margin at the same nominal density
+        lt_new = k2threshold_method(abs_acc,
+                                    _target_k(k, n, cfg.local_k_target),
+                                    cfg.threshold_method,
                                     cfg.bisect_iters).astype(acc.dtype)
         # drift measured between consecutive *exact* thresholds (the
         # running predicted one is polluted by the controller's own
@@ -193,7 +213,8 @@ def oktopk(grad: jnp.ndarray, state: SparseState, cfg: OkTopkConfig,
     # threshold feedback for the next step (the probe count fuses into the
     # same pass over abs_acc)
     local_probe = jnp.sum(abs_acc >= lt * cfg.probe_ratio)
-    lt_next = _newton_adapt(lt, local_count, local_probe, k, cfg)
+    lt_next = _newton_adapt(lt, local_count, local_probe, k, cfg,
+                            target=_target_k(k, n, cfg.local_k_target))
 
     # ---- phase (b): global winner selection + allgather.
     cap_g = cfg.cap_gather
@@ -261,7 +282,8 @@ def oktopk(grad: jnp.ndarray, state: SparseState, cfg: OkTopkConfig,
                       axis_name)
         total_g = totals[0].astype(jnp.int32)
         gt_next = _newton_adapt(gt_use, total_g, totals[1].astype(jnp.int32),
-                                k, cfg, band_hi=cfg.band_hi_global)
+                                k, cfg, band_hi=cfg.band_hi_global,
+                                target=_target_k(k, n, cfg.global_k_target))
         vol = 2.0 * gcount + 2.0 * (total_g - gcount)
         return pvary_like((result, gt_next, total_g, vol), acc)
 

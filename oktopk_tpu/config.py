@@ -91,6 +91,16 @@ class OkTopkConfig:
     # the 6k budget; capping the global dead zone at 1.0*k targets ~5.7k
     # with margin. Local selection keeps the full reference band.
     band_hi_global: float = 1.0
+    # Controller setpoints, as factors of k. 1.0 chases exactly k (the
+    # reference behaviour); slightly below 1 operates realised counts in
+    # the lower half of the reference band [2k/3, 5k/4] — still the same
+    # nominal density d, but with volume margin under the 6k budget
+    # instead of sitting 5% from the line (VERDICT r4). local applies to
+    # the exact local-threshold recompute and local feedback; global to
+    # the predicted-phase global feedback (exact global recomputes still
+    # deliver exactly k winners).
+    local_k_target: float = 0.9
+    global_k_target: float = 0.85
 
     # Fixed-capacity factors. XLA has no ragged collectives (no Allgatherv /
     # size Alltoall), so every variable-length exchange in the reference

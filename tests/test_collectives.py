@@ -188,11 +188,18 @@ class TestOkTopk:
                 vols.append(float(state.last_volume[0]))
         # STRICT reading of the paper's bound: 6k *scalars* total — the
         # same interpretation bench.py and docs/PERF.md:18-23 hold the
-        # measured steady state to (62,914 at n=2^20, density 0.01)
+        # measured steady state to (62,914 at n=2^20, density 0.01).
+        # The r5 controller setpoints (local_k_target/global_k_target)
+        # operate at ~0.80x the budget at scale (asserted 0.85x in the
+        # VGG-scale test below and measured in bench.py); HERE k is only
+        # 40, so integer counts and the +8-element capacity rounding cost
+        # a few percent of margin — 0.90x is the tight bound this size
+        # supports (measured 0.86x).
         budget = 6.0 * k
         # the paper's property is the steady-state *mean*, not the best step
-        assert sum(vols) / len(vols) < budget, \
-            f"mean volume {sum(vols)/len(vols):.0f} vs 6k budget {budget}"
+        assert sum(vols) / len(vols) < 0.90 * budget, \
+            f"mean volume {sum(vols)/len(vols):.0f} vs 0.90 x 6k " \
+            f"budget {0.90 * budget:.0f}"
         for v in vols:
             assert v < 2 * budget, f"volume {v} vs budget {budget}"
             assert v < 2.0 * n / 4, "not meaningfully sparser than dense"
@@ -252,8 +259,9 @@ class TestOkTopk:
             if i % 4 != 0:  # predicted-global steps
                 vols.append(float(state.last_volume[0]))
         budget = 6.0 * k
-        assert sum(vols) / len(vols) < budget, \
-            f"mean volume {sum(vols)/len(vols):.0f} vs 6k budget {budget}"
+        assert sum(vols) / len(vols) < 0.85 * budget, \
+            f"mean volume {sum(vols)/len(vols):.0f} vs 0.85 x 6k " \
+            f"budget {0.85 * budget:.0f}"
 
     def test_repartition_preserves_invariant(self, mesh8):
         rng = np.random.RandomState(5)
@@ -383,6 +391,37 @@ class TestGtopk:
         step = build_allreduce_step("gtopk", cfg, mesh8, warmup=False)
         _, state = step(grads, batched_init_state(cfg))
         assert float(state.last_volume[0]) == 4.0 * cfg.k * 3  # log2(8)=3
+
+    def test_mass_conservation_losers_return_to_residual(self, mesh8,
+                                                         grads):
+        """Error-feedback identity: sum_w residual_w + P * result ==
+        sum_w grad_w elementwise. The reference keeps every originally
+        selected value whose index loses the global re-selection
+        (included_indexes, VGG/allreducer.py:171-172 -> add_residuals at
+        :1406-1411); before the round-5 fix those values were dropped,
+        losing ~(P-1)/P of selected mass per step and stalling training
+        (mnistnet flat at chance).
+
+        Mid-tree collision drops are the one sanctioned leak — a coord
+        that wins globally can still lose one branch's contribution in an
+        early round, and the reference leaks exactly those too (its
+        included_indexes is selection-intersect-final regardless of
+        mid-merge drops) — so the identity is asserted off the winner
+        support and the leak is pinned to winners only."""
+        cfg = make_cfg(density=0.05, wire_dtype="float32")
+        step = build_allreduce_step("gtopk", cfg, mesh8, warmup=False)
+        out, state = step(grads, batched_init_state(cfg))
+        total_in = np.asarray(grads).sum(0)
+        total_out = (np.asarray(state.residual).sum(0)
+                     + P * np.asarray(out[0]))
+        winners = np.asarray(out[0]) != 0.0
+        np.testing.assert_allclose(total_out[~winners], total_in[~winners],
+                                   atol=1e-4)
+        # winner-side leak exists but is collision-scale, not
+        # whole-selection scale (pre-fix, ~7/8 of selected mass leaked)
+        leak = np.abs(total_out - total_in).sum()
+        sel_mass = np.abs(total_in).sum()
+        assert leak < 0.05 * sel_mass
 
 
 class TestTopkSA:
