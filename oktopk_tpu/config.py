@@ -209,6 +209,15 @@ class OkTopkConfig:
                 raise ValueError(
                     "density_schedule needs threshold_method='bisect' "
                     "(a traced target k; lax.top_k wants it static)")
+        for name in ("local_k_target", "global_k_target"):
+            f = getattr(self, name)
+            # below band_lo the setpoint fights its own dead zone (every
+            # correction lands out-of-band low and is immediately pushed
+            # back); above 1 it would overshoot the nominal density
+            if not (self.band_lo <= f <= 1.0):
+                raise ValueError(
+                    f"{name}={f} must lie in [band_lo={self.band_lo:.3f}"
+                    ", 1.0]")
 
     @property
     def wire_value_bytes(self) -> int:

@@ -43,7 +43,10 @@ VARIANTS = {
     "d010":    ({"density": 0.10}, {}),
     "d016":    ({"density": 0.16}, {}),
     "w400":    ({}, {"warmup_steps": 400}),
-    "bandk":   ({}, {"band_lo": 1.0, "band_hi": 1.5, "band_hi_global": 1.5}),
+    # setpoints ride along: band_lo=1.0 forces the exact-k operating
+    # point, so the sub-k r5 defaults would violate band_lo <= target
+    "bandk":   ({}, {"band_lo": 1.0, "band_hi": 1.5, "band_hi_global": 1.5,
+                     "local_k_target": 1.0, "global_k_target": 1.0}),
     "drift05": ({}, {"drift_ema": 0.5}),
     "rec8":    ({}, {"local_recompute_every": 8, "global_recompute_every": 8}),
     # the two knobs that moved the needle, combined (warmup is free —

@@ -234,6 +234,12 @@ class TestOkTopk:
         with pytest.raises(ValueError):
             OkTopkConfig(n=n, density=0.05, threshold_method="sort",
                          density_schedule=((0, 0.01),))
+        # controller setpoints must stay inside [band_lo, 1.0]: below the
+        # dead zone they fight it, above 1 they overshoot the density
+        with pytest.raises(ValueError):
+            OkTopkConfig(n=n, density=0.05, local_k_target=0.5)
+        with pytest.raises(ValueError):
+            OkTopkConfig(n=n, density=0.05, global_k_target=1.2)
 
     @pytest.mark.slow
     def test_comm_volume_below_6k_at_vgg_scale(self, mesh8):

@@ -23,8 +23,13 @@ def run_steps(trainer, n, batch_size, seed=0):
 class TestMnistOkTopk:
     @pytest.fixture(scope="class")
     def trainer(self, mesh4):
+        # lr 0.02, not 0.05: with sparse-from-random-init (warmup=False)
+        # the fixed-batch loss is chaotic at 0.05 (spikes to ~8 then
+        # oscillates; whether step 6 lands above or below step 1 was luck
+        # of the controller's early counts), while 0.02 descends cleanly
+        # — and a genuinely broken update path still fails at any lr
         cfg = TrainConfig(dnn="mnistnet", dataset="mnist", batch_size=8,
-                          lr=0.05, compressor="oktopk", density=0.05)
+                          lr=0.02, compressor="oktopk", density=0.05)
         return Trainer(cfg, mesh=mesh4, warmup=False)
 
     def test_loss_decreases(self, trainer):
