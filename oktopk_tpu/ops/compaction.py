@@ -395,22 +395,42 @@ def _pack_by_region_pallas(x, thresh, boundaries, num_regions: int,
                                     interpret, vma)
 
     def _post(w_stage, stored, capb):
-        # region reconstruction requires every survivor staged, which the
-        # caller guarantees (no overflow, or the capb=BLK kernel)
+        # Region reconstruction requires every survivor staged, which the
+        # caller guarantees (no overflow, or the capb=BLK kernel). Regions
+        # are contiguous index ranges, so a block's region is determined by
+        # its START index alone — except for the <= R-1 blocks that contain
+        # an interior boundary, whose split is read off their (ascending-
+        # offset) staging rows. Everything here is nb- or (R-1)*capb-scale;
+        # the round-4 version ran searchsorted + a scatter-add over the
+        # whole [nb, capb] grid, which on the capb=BLK wide path is
+        # n-scale — measured 150+ ms of the VGG-16 step on the chip (the
+        # very scatter cost this module exists to avoid).
         bi = jnp.arange(nblocks, dtype=jnp.int32)
-        valid = (jnp.arange(capb, dtype=jnp.int32)[None, :]
-                 < stored[:, None])                       # [nb, capb]
-        idxg = (bi[:, None] * BLK + w_stage.astype(jnp.int32))
-        # region id = #interior boundaries <= idxg: O(staged * log R)
-        # searchsorted (matching the portable path) instead of an R-1 loop
-        # of dense [nb, capb] compares, which scales linearly with the
-        # region/worker count
-        rid = jnp.searchsorted(bnd[1:-1], idxg,
-                               side="right").astype(jnp.int32)
-        # per-(block, region) survivor counts, via one small scatter-add
-        cnt_rb = jnp.zeros((nblocks, R), jnp.int32).at[
-            jnp.broadcast_to(bi[:, None], idxg.shape), rid].add(
-            valid.astype(jnp.int32))
+        rblock = jnp.searchsorted(bnd[1:-1], bi * BLK,
+                                  side="right").astype(jnp.int32)   # [nb]
+        rgrid = jnp.arange(R, dtype=jnp.int32)
+        cnt_rb = jnp.where(rblock[:, None] == rgrid[None, :],
+                           stored[:, None], 0)            # [nb, R]
+        if R > 1:
+            # boundary-straddling blocks: exact per-region counts from the
+            # staged offsets. Duplicate bm rows (several boundaries inside
+            # one block) compute identical replacement rows, so the
+            # .at[].set is deterministic.
+            # clamp: a boundary equal to n with zero padding puts bm one
+            # past the last block; the clamped block's replacement row is
+            # recomputed from its own staging, so the overwrite stays exact
+            bm = jnp.minimum((bnd[1:-1] // BLK).astype(jnp.int32),
+                             nblocks - 1)                 # [R-1]
+            wb = w_stage[bm].astype(jnp.int32)            # [R-1, capb]
+            rid_b = jnp.searchsorted(bnd[1:-1], bm[:, None] * BLK + wb,
+                                     side="right").astype(jnp.int32)
+            valid_b = (jnp.arange(capb, dtype=jnp.int32)[None, :]
+                       < stored[bm][:, None])             # [R-1, capb]
+            rowg = jnp.broadcast_to(
+                jnp.arange(R - 1, dtype=jnp.int32)[:, None], rid_b.shape)
+            cnt_rows = jnp.zeros((R - 1, R), jnp.int32).at[
+                rowg, rid_b].add(valid_b.astype(jnp.int32))
+            cnt_rb = cnt_rb.at[bm].set(cnt_rows)
         off_rb = jnp.cumsum(cnt_rb, axis=1) - cnt_rb      # region start in row
         counts = jnp.minimum(jnp.sum(cnt_rb, axis=0), cap)  # [R]
         values, indices = _materialize(

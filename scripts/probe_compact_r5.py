@@ -1,0 +1,147 @@
+"""Round-5 chip probe: decompose the compiled kernel-path compaction cost.
+
+The round-5 capture measured the oktopk VGG-16 step at 387 ms vs the cost
+model's ~110-130 ms. This probe answers, on the real chip with a REAL
+VGG-16 gradient (not synthetic noise — overflow behavior depends on the
+spatial correlation of conv gradients):
+
+  1. How often does a 1024-element block overflow the 128-wide staging
+     (raw > CAPB_FAST)?  Any overflow switches the whole pack call to the
+     1024-wide kernel (`ops/compaction.py` lax.cond) — if that fires every
+     step, the step pays the wide kernel, not the fast one.
+  2. Per-piece device times (queued iters, one sync — robust to host
+     dispatch noise): fast stage, wide stage, full select, pack R=8,
+     and the full oktopk allreduce on the same gradient.
+
+Usage: JAX_PLATFORMS=axon python scripts/probe_compact_r5.py
+"""
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.flatten_util as fu
+import jax.numpy as jnp
+import numpy as np
+
+
+def timed(fn, *args, iters=8, warmup=2):
+    for _ in range(warmup):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    return round((time.perf_counter() - t0) / iters * 1e3, 3)
+
+
+def main():
+    from oktopk_tpu.comm.mesh import get_mesh
+    from oktopk_tpu.config import TrainConfig
+    from oktopk_tpu.data.synthetic import synthetic_batch
+    from oktopk_tpu.train.trainer import Trainer
+    from oktopk_tpu.ops import compaction as C
+
+    dev = jax.devices()[0]
+    mesh = get_mesh((1,), ("data",), devices=[dev])
+    out = {"device": dev.platform}
+
+    # one real VGG-16 gradient (flattened), via the trainer's own loss
+    cfg = TrainConfig(dnn="vgg16", dataset="cifar10", batch_size=16,
+                      lr=0.1, compressor="dense", density=0.02,
+                      num_workers=1)
+    tr = Trainer(cfg, mesh=mesh, warmup=False)
+    rng = np.random.RandomState(0)
+    batch = jax.device_put(synthetic_batch("vgg16", 16, rng))
+    key = jax.random.PRNGKey(0)
+
+    params = tr.state.params
+    model_state = tr.state.model_state
+
+    def loss_only(p):
+        return tr._loss_fn(p, model_state, batch, key)[0]
+
+    grads = jax.jit(jax.grad(loss_only))(params)
+    gflat, _ = fu.ravel_pytree(grads)
+    gflat = jax.device_put(gflat)
+    n = int(gflat.size)
+    out["n"] = n
+
+    d = 0.02
+    k = int(n * d)
+    absg = jnp.abs(gflat)
+    thresh = float(jnp.sort(absg)[-k])
+    out["k"] = k
+
+    # 1. block overflow census on the real gradient
+    pad = (-n) % 1024
+    blocks = jnp.pad(absg, (0, pad)).reshape(-1, 1024)
+    raw = np.asarray(jnp.sum(blocks >= thresh, axis=1))
+    out["blocks"] = int(raw.size)
+    out["blocks_over_128"] = int((raw > 128).sum())
+    out["max_block_survivors"] = int(raw.max())
+    out["mean_block_survivors"] = round(float(raw.mean()), 2)
+    print("CENSUS " + json.dumps(out), flush=True)
+
+    # 2. device times, queued iters
+    capacity = max(2 * k, 1024)          # generous single-region capacity
+    sel = jax.jit(lambda x: C.select_by_threshold_pallas(x, thresh,
+                                                         capacity))
+    out["select_full_ms"] = timed(sel, gflat)
+    print("TIMES " + json.dumps(out), flush=True)
+
+    xp, xflat, t, rrange, _, nblocks = C._prep(gflat, thresh, None, None)
+
+    @jax.jit
+    def stage_fast(xp, t, rrange):
+        return C._run_stage(xp, t, rrange, C.CAPB_FAST, nblocks, False,
+                            frozenset())
+
+    @jax.jit
+    def stage_wide(xp, t, rrange):
+        return C._run_stage(xp, t, rrange, C.BLK, nblocks, False,
+                            frozenset())
+
+    out["stage_fast_ms"] = timed(stage_fast, xp, t, rrange)
+    print("TIMES " + json.dumps(out), flush=True)
+    out["stage_wide_ms"] = timed(stage_wide, xp, t, rrange)
+    print("TIMES " + json.dumps(out), flush=True)
+
+    # pack_by_region R=8 with even boundaries (the oktopk phase-A shape)
+    R = 8
+    bnd = np.linspace(0, n, R + 1).astype(np.int32)
+    bnd[0], bnd[-1] = 0, n
+    capr = max(capacity // R, 1024)
+    pk = jax.jit(lambda x: C.pack_by_region_pallas(
+        x, thresh, jnp.asarray(bnd), R, capr))
+    out["pack_r8_ms"] = timed(pk, gflat)
+    print("TIMES " + json.dumps(out), flush=True)
+
+    # full oktopk sparse allreduce on the same-sized gradient, P=1 mesh
+    try:
+        from oktopk_tpu.config import OkTopkConfig
+        from oktopk_tpu.collectives.api import batched_init_state, \
+            build_allreduce_step
+        acfg = OkTopkConfig(n=n, num_workers=1, density=d, warmup_steps=0)
+        from oktopk_tpu.ops.compaction import resolve_use_pallas
+        step = build_allreduce_step("oktopk", acfg, mesh, warmup=False)
+        st = batched_init_state(resolve_use_pallas(acfg, mesh))
+        g2 = gflat[None]
+
+        def one(g, s):
+            return step(g, s)
+
+        # steady state: advance past the first (exact-recompute) step
+        _, st2 = one(g2, st)
+        out["oktopk_allreduce_ms"] = timed(one, g2, st2)
+    except Exception as e:
+        out["oktopk_allreduce_err"] = repr(e)
+    print("PROBE " + json.dumps(out), flush=True)
+
+
+if __name__ == "__main__":
+    main()
