@@ -30,14 +30,24 @@ proof that a running element count cannot give. Block-granular staging
 sidesteps every such constraint: all kernel outputs are statically blocked.
 
 Exactness: the staging width ``capb`` (128) caps how many survivors one
-block can stage. Blocks almost never exceed it in the threshold-band
-regime (~20 survivors/block at the paper's densities), but a correlated
-gradient can: the kernel therefore also emits *raw* per-block survivor
-counts, and the wrapper switches (``lax.cond``) to a capb=1024 kernel —
-which can never drop anything — whenever a block overflowed and the drop
-could matter. Both paths reproduce the portable result bit-for-bit
-(asserted in tests/test_compaction.py and on real hardware in
-tests/test_tpu_hw.py).
+block can stage. The mean is ~20 survivors/block at the paper's densities,
+but conv gradients are spatially correlated: on a real VGG-16 gradient at
+d=0.02, 4.3% of blocks overflow (max 826/1024) — every step. The kernel
+therefore also emits *raw* per-block survivor counts, and the wrapper
+dispatches (``lax.switch``) on the overflow census:
+
+  * no overflow that matters  -> fast rows alone (the common small-n case);
+  * <= ``_novf_cap`` blocks   -> a *repair* kernel re-stages only the
+    overflowing blocks at full 1024 width (their ids scalar-prefetched
+    into the input index_map), ~nblocks/8 block-stagings instead of
+    nblocks — measured 9 ms vs the 69 ms full-wide re-stage on v5e;
+    ``_materialize_het`` then reads the mixed 128/1024-wide layout via
+    one extra telescoping accumulator (the per-slot source block);
+  * more                       -> the capb=1024 kernel over everything
+    (can never drop anything), as before.
+
+All paths reproduce the portable result bit-for-bit (asserted in
+tests/test_compaction.py and on real hardware in tests/test_tpu_hw.py).
 
 The reference's analogous code is the boolean-mask nonzero select
 (``compressbythreshold``, VGG/compression.py:122-142) — a cheap op on GPU,
@@ -204,6 +214,62 @@ def _run_stage(xp, t, rng, capb, nblocks, interpret, vma):
     return w, jnp.minimum(raw, capb), raw
 
 
+def _novf_cap(nblocks: int) -> int:
+    """Static capacity of the repair list: an eighth of the blocks (3x the
+    measured 4.3% overflow rate on real VGG-16 gradients at d=0.02)."""
+    return max((nblocks + 7) // 8, 8)
+
+
+def _repair_kernel(t_ref, r_ref, bl_ref, x_ref, w_ref):
+    """Re-stage ONE overflowing block (id scalar-prefetched via ``bl_ref``)
+    at full 1024 width, written as eight 128-wide *pages* (page p holds
+    packed slots [128p, 128(p+1))) — a [1, 1024] staging row would need a
+    cross-lane reshape Mosaic rejects; pages keep every store a [1, 128]
+    lane row. Row-major [8, 128] flatten == the 1024-wide row layout."""
+    import jax.experimental.pallas as pl
+
+    i = pl.program_id(0)
+    b = bl_ref[i]
+    x = x_ref[:]                                          # [8, 128]
+    woff = (jax.lax.broadcasted_iota(jnp.int32, (BLK_ROWS, BLK_COLS), 0)
+            * BLK_COLS
+            + jax.lax.broadcasted_iota(jnp.int32, (BLK_ROWS, BLK_COLS), 1))
+    gidx = b * BLK + woff
+    mask = ((jnp.abs(x) >= t_ref[0])
+            & (gidx >= r_ref[0]) & (gidx < r_ref[1]))
+    pos, _raw = _block_prefix(mask.astype(jnp.int32))
+    for p in range(BLK_ROWS):
+        kept_p = mask & (pos >= p * BLK_COLS) & (pos < (p + 1) * BLK_COLS)
+        sel_p = jnp.where(kept_p, pos - p * BLK_COLS, BLK_COLS)
+        w_ref[p:p + 1, :] = _stage_tile(jnp.where(kept_p, woff, 0), sel_p,
+                                        BLK_COLS)
+
+
+def _run_repair(xp, t, rng, bl, novf_cap, interpret, vma):
+    """pallas_call wrapper: w_rep [novf_cap * 8, 128] f32 staging pages for
+    the blocks listed in ``bl`` (padded entries re-stage block 0; their
+    rows are never addressed — see ``_materialize_het``)."""
+    import jax.experimental.pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,
+        grid=(novf_cap,),
+        in_specs=[pl.BlockSpec((BLK_ROWS, BLK_COLS),
+                               lambda i, t, r, bl: (bl[i], 0))],
+        out_specs=[pl.BlockSpec((BLK_ROWS, BLK_COLS),
+                                lambda i, t, r, bl: (i, 0))],
+    )
+    (w,) = pl.pallas_call(
+        _repair_kernel,
+        grid_spec=grid_spec,
+        out_shape=[jax.ShapeDtypeStruct((novf_cap * BLK_ROWS, BLK_COLS),
+                                        jnp.float32, vma=vma)],
+        interpret=interpret,
+    )(t, rng, bl, xp)
+    return w
+
+
 def _materialize(w_stage, xflat, cnt_rb, off_rb, capb, cap, counts, n):
     """Materialise ``(values [R, cap], indices [R, cap])`` from a packed
     staging ``w_stage [nb, capb]`` whose block b holds (ascending-index)
@@ -247,6 +313,86 @@ def _materialize(w_stage, xflat, cnt_rb, off_rb, capb, cap, counts, n):
                        0.0)                           # gather round 2
     indices = jnp.where(live, idx, n).astype(jnp.int32)
     return values, indices
+
+
+def _materialize_het(w_fast, w_rep, ovf, xflat, cnt_rb, off_rb, capf, cap,
+                     counts, n):
+    """``_materialize`` over the mixed staging layout of the repair path:
+    block b's row is ``w_rep`` page-row ``rank(b)`` (1024 wide) when
+    ``ovf[b]``, else ``w_fast[b]`` (``capf`` wide).
+
+    Same telescoping-jump construction, with per-block widths ``capb_b``
+    in the jump values and ONE extra accumulator carrying the per-slot
+    source block id b (jump +1 at every block crossing) — b can no longer
+    be recovered as ``flat // capb`` — plus one nb-operand gather of
+    ``delta[b] = phys_base[b] - vbase[b]`` translating virtual addresses
+    into the concatenated [w_fast | w_rep] physical array."""
+    nblocks, R = cnt_rb.shape
+    if off_rb is None:
+        off_rb = jnp.zeros_like(cnt_rb)
+    capb_b = jnp.where(ovf, BLK, capf)                    # [nb]
+    vbase = jnp.cumsum(capb_b) - capb_b                   # virtual row base
+    rank = jnp.cumsum(ovf.astype(jnp.int32)) - ovf        # repair row of b
+    fast_sz = nblocks * capf
+    phys_base = jnp.where(ovf, fast_sz + rank * BLK,
+                          jnp.arange(nblocks, dtype=jnp.int32) * capf)
+    delta = phys_base - vbase                             # [nb]
+
+    c_rb = jnp.cumsum(cnt_rb, axis=0)                     # [nb, R] inclusive
+    off_next = jnp.concatenate([off_rb[1:], off_rb[-1:]], axis=0)
+    fval = capb_b[:, None] + off_next - off_rb - cnt_rb   # [nb, R]
+    pos = jnp.minimum(c_rb, cap)
+    rgrid = jnp.broadcast_to(jnp.arange(R, dtype=jnp.int32)[None, :],
+                             (nblocks, R))
+    fjump = jnp.zeros((R, cap + 1), jnp.int32).at[rgrid.T, pos.T].add(fval.T)
+    bjump = jnp.zeros((R, cap + 1), jnp.int32).at[rgrid.T, pos.T].add(
+        jnp.ones_like(fval.T))
+    j = jnp.arange(cap, dtype=jnp.int32)[None, :]
+    flat = off_rb[0][:, None] + jnp.cumsum(fjump, axis=1)[:, :cap] + j
+    b = jnp.minimum(jnp.cumsum(bjump, axis=1)[:, :cap], nblocks - 1)
+    stage_all = jnp.concatenate([w_fast.reshape(-1), w_rep.reshape(-1)])
+    phys = jnp.clip(flat + delta[b], 0, stage_all.size - 1)
+    w = stage_all[phys].astype(jnp.int32)                 # gather round 1
+    idx = b * BLK + w
+    live = j < counts[:, None]
+    values = jnp.where(live, xflat[jnp.minimum(idx, xflat.size - 1)],
+                       0.0)                               # gather round 2
+    indices = jnp.where(live, idx, n).astype(jnp.int32)
+    return values, indices
+
+
+def _region_counts(stage_flat, phys_base, stored_v, capb_max, bnd, R,
+                   nblocks):
+    """Per-(block, region) staged-survivor counts [nb, R] for contiguous
+    index-range regions, at nb scale: a block's region follows from its
+    start index; only the <= R-1 boundary-straddling blocks read their
+    staging rows (fetched from ``stage_flat`` at ``phys_base`` — uniform
+    and heterogeneous layouts both reduce to a base array)."""
+    rgrid = jnp.arange(R, dtype=jnp.int32)
+    bi = jnp.arange(nblocks, dtype=jnp.int32)
+    rblock = jnp.searchsorted(bnd[1:-1], bi * BLK,
+                              side="right").astype(jnp.int32)
+    cnt_rb = jnp.where(rblock[:, None] == rgrid[None, :],
+                       stored_v[:, None], 0)
+    if R > 1:
+        # clamp: a boundary equal to n with zero padding puts bm one past
+        # the last block; the clamped block's replacement row is recomputed
+        # from its own staging, so the overwrite stays exact
+        bm = jnp.minimum((bnd[1:-1] // BLK).astype(jnp.int32), nblocks - 1)
+        rowidx = phys_base[bm][:, None] + jnp.arange(capb_max,
+                                                     dtype=jnp.int32)[None, :]
+        wb = stage_flat[jnp.clip(rowidx, 0, stage_flat.size - 1)] \
+            .astype(jnp.int32)                            # [R-1, capb_max]
+        rid_b = jnp.searchsorted(bnd[1:-1], bm[:, None] * BLK + wb,
+                                 side="right").astype(jnp.int32)
+        valid_b = (jnp.arange(capb_max, dtype=jnp.int32)[None, :]
+                   < stored_v[bm][:, None])
+        rowg = jnp.broadcast_to(
+            jnp.arange(R - 1, dtype=jnp.int32)[:, None], rid_b.shape)
+        cnt_rows = jnp.zeros((R - 1, R), jnp.int32).at[
+            rowg, rid_b].add(valid_b.astype(jnp.int32))
+        cnt_rb = cnt_rb.at[bm].set(cnt_rows)
+    return cnt_rb
 
 
 def _prep(x, thresh, lo, hi):
@@ -315,20 +461,38 @@ def select_by_threshold_pallas(x: jnp.ndarray, thresh, cap: int,
         return values[0], indices[0]
 
     if cap > capb_f:
+        # A block's drops have in-block position >= capb, hence global
+        # survivor rank >= excl_cumsum(raw)[b] + capb. When every drop
+        # ranks >= cap, no output slot can see one (a survivor with true
+        # rank < cap has no drop before it either, so the stored ordering
+        # of the first cap slots is exact) — such blocks need no re-stage.
+        excl = jnp.cumsum(raw) - raw
+        matters = (raw > capb_f) & (excl + capb_f < cap)
+        novf = jnp.sum(matters)
+        ncap = _novf_cap(nblocks)
+        bl = jnp.nonzero(matters, size=ncap,
+                         fill_value=0)[0].astype(jnp.int32)
+
+        def fast(_):
+            return _post(w_f, stored_f, capb_f)
+
+        def repair(_):
+            blv = _pvary_to(bl, vma) if vma else bl
+            w_rep = _run_repair(xp, t, rng, blv, ncap, interpret, vma)
+            stored_v = jnp.where(matters, raw, stored_f)
+            values, indices = _materialize_het(
+                w_f, w_rep, matters, xflat, stored_v[:, None], None,
+                capb_f, cap, count[None], n)
+            return values[0], indices[0]
+
         def wide(_):
             w_w, stored_w, _raw = _run_stage(xp, t, rng, BLK, nblocks,
                                              interpret, vma)
             return _post(w_w, stored_w, BLK)
 
-        # A block's drops have in-block position >= capb, hence global
-        # survivor rank >= excl_cumsum(raw)[b] + capb. When every drop
-        # ranks >= cap, no output slot can see one (a survivor with true
-        # rank < cap has no drop before it either, so the stored ordering
-        # of the first cap slots is exact) — skip the full-width re-stage.
-        excl = jnp.cumsum(raw) - raw
-        values, indices = jax.lax.cond(
-            jnp.any((raw > capb_f) & (excl + capb_f < cap)), wide,
-            lambda _: _post(w_f, stored_f, capb_f), None)
+        sel = ((novf > 0).astype(jnp.int32)
+               + (novf > ncap).astype(jnp.int32))
+        values, indices = jax.lax.switch(sel, [fast, repair, wide], None)
     else:
         # drops beyond capb have in-block position >= capb >= cap, hence
         # global position >= cap: they can never make the first-cap prefix
@@ -394,56 +558,54 @@ def _pack_by_region_pallas(x, thresh, boundaries, num_regions: int,
     w_f, stored_f, raw = _run_stage(xp, t, rng, CAPB_FAST, nblocks,
                                     interpret, vma)
 
-    def _post(w_stage, stored, capb):
-        # Region reconstruction requires every survivor staged, which the
-        # caller guarantees (no overflow, or the capb=BLK kernel). Regions
-        # are contiguous index ranges, so a block's region is determined by
-        # its START index alone — except for the <= R-1 blocks that contain
-        # an interior boundary, whose split is read off their (ascending-
-        # offset) staging rows. Everything here is nb- or (R-1)*capb-scale;
-        # the round-4 version ran searchsorted + a scatter-add over the
-        # whole [nb, capb] grid, which on the capb=BLK wide path is
-        # n-scale — measured 150+ ms of the VGG-16 step on the chip (the
-        # very scatter cost this module exists to avoid).
-        bi = jnp.arange(nblocks, dtype=jnp.int32)
-        rblock = jnp.searchsorted(bnd[1:-1], bi * BLK,
-                                  side="right").astype(jnp.int32)   # [nb]
-        rgrid = jnp.arange(R, dtype=jnp.int32)
-        cnt_rb = jnp.where(rblock[:, None] == rgrid[None, :],
-                           stored[:, None], 0)            # [nb, R]
-        if R > 1:
-            # boundary-straddling blocks: exact per-region counts from the
-            # staged offsets. Duplicate bm rows (several boundaries inside
-            # one block) compute identical replacement rows, so the
-            # .at[].set is deterministic.
-            # clamp: a boundary equal to n with zero padding puts bm one
-            # past the last block; the clamped block's replacement row is
-            # recomputed from its own staging, so the overwrite stays exact
-            bm = jnp.minimum((bnd[1:-1] // BLK).astype(jnp.int32),
-                             nblocks - 1)                 # [R-1]
-            wb = w_stage[bm].astype(jnp.int32)            # [R-1, capb]
-            rid_b = jnp.searchsorted(bnd[1:-1], bm[:, None] * BLK + wb,
-                                     side="right").astype(jnp.int32)
-            valid_b = (jnp.arange(capb, dtype=jnp.int32)[None, :]
-                       < stored[bm][:, None])             # [R-1, capb]
-            rowg = jnp.broadcast_to(
-                jnp.arange(R - 1, dtype=jnp.int32)[:, None], rid_b.shape)
-            cnt_rows = jnp.zeros((R - 1, R), jnp.int32).at[
-                rowg, rid_b].add(valid_b.astype(jnp.int32))
-            cnt_rb = cnt_rb.at[bm].set(cnt_rows)
-        off_rb = jnp.cumsum(cnt_rb, axis=1) - cnt_rb      # region start in row
+    # Region reconstruction requires every survivor staged (fast rows when
+    # nothing overflowed, repaired rows for the <= ncap overflow blocks,
+    # or the capb=BLK kernel otherwise). _region_counts is nb-scale — the
+    # round-4 version ran searchsorted + a scatter-add over the whole
+    # [nb, capb] grid, which on the capb=BLK wide path is n-scale:
+    # measured 150+ ms of the VGG-16 step on the chip (the very scatter
+    # cost this module exists to avoid).
+    def _finish(cnt_rb, mat):
+        off_rb = jnp.cumsum(cnt_rb, axis=1) - cnt_rb    # region start in row
         counts = jnp.minimum(jnp.sum(cnt_rb, axis=0), cap)  # [R]
-        values, indices = _materialize(
-            w_stage, xflat, cnt_rb, off_rb, capb, cap, counts, n)
+        values, indices = mat(cnt_rb, off_rb, counts)
         return values, indices, counts
+
+    bi = jnp.arange(nblocks, dtype=jnp.int32)
+    ovf = raw > CAPB_FAST
+    novf = jnp.sum(ovf)
+    ncap = _novf_cap(nblocks)
+    bl = jnp.nonzero(ovf, size=ncap, fill_value=0)[0].astype(jnp.int32)
+
+    def fast(_):
+        cnt_rb = _region_counts(w_f.reshape(-1), bi * CAPB_FAST, stored_f,
+                                CAPB_FAST, bnd, R, nblocks)
+        return _finish(cnt_rb, lambda c, o, ct: _materialize(
+            w_f, xflat, c, o, CAPB_FAST, cap, ct, n))
+
+    def repair(_):
+        blv = _pvary_to(bl, vma) if vma else bl
+        w_rep = _run_repair(xp, t, rng, blv, ncap, interpret, vma)
+        stored_v = jnp.where(ovf, raw, stored_f)
+        rank = jnp.cumsum(ovf.astype(jnp.int32)) - ovf
+        phys_base = jnp.where(ovf, nblocks * CAPB_FAST + rank * BLK,
+                              bi * CAPB_FAST)
+        stage_all = jnp.concatenate([w_f.reshape(-1), w_rep.reshape(-1)])
+        cnt_rb = _region_counts(stage_all, phys_base, stored_v, BLK, bnd,
+                                R, nblocks)
+        return _finish(cnt_rb, lambda c, o, ct: _materialize_het(
+            w_f, w_rep, ovf, xflat, c, o, CAPB_FAST, cap, ct, n))
 
     def wide(_):
         w_w, stored_w, _raw = _run_stage(xp, t, rng, BLK, nblocks,
                                          interpret, vma)
-        return _post(w_w, stored_w, BLK)
+        cnt_rb = _region_counts(w_w.reshape(-1), bi * BLK, stored_w, BLK,
+                                bnd, R, nblocks)
+        return _finish(cnt_rb, lambda c, o, ct: _materialize(
+            w_w, xflat, c, o, BLK, cap, ct, n))
 
-    return jax.lax.cond(jnp.any(raw > CAPB_FAST), wide,
-                        lambda _: _post(w_f, stored_f, CAPB_FAST), None)
+    sel = (novf > 0).astype(jnp.int32) + (novf > ncap).astype(jnp.int32)
+    return jax.lax.switch(sel, [fast, repair, wide], None)
 
 
 def mesh_supports_pallas(mesh) -> bool:

@@ -1,0 +1,174 @@
+"""Prototype: overflow-repair staging kernel, tested directly on the chip.
+
+Checks the two Mosaic-sensitive ingredients before wiring into
+ops/compaction.py:
+  1. scalar-prefetch-dependent input index_map (gather arbitrary blocks);
+  2. pl.when page predication on a vector-reduction-derived scalar.
+
+Parity oracle: the existing wide kernel's staging rows for the same blocks.
+
+Usage: JAX_PLATFORMS=axon python scripts/proto_repair_kernel.py
+"""
+import functools
+import json
+import sys
+import os
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from oktopk_tpu.ops import compaction as C
+
+BLK_ROWS, BLK_COLS, BLK, SB = C.BLK_ROWS, C.BLK_COLS, C.BLK, C.SB
+
+
+def _repair_kernel(use_when, t_ref, r_ref, bl_ref, x_ref, w_ref):
+    import jax.experimental.pallas as pl
+
+    i = pl.program_id(0)
+    b = bl_ref[i]
+    x = x_ref[:]                                          # [8, 128]
+    woff = (jax.lax.broadcasted_iota(jnp.int32, (BLK_ROWS, BLK_COLS), 0)
+            * BLK_COLS
+            + jax.lax.broadcasted_iota(jnp.int32, (BLK_ROWS, BLK_COLS), 1))
+    gidx = b * BLK + woff
+    mask = ((jnp.abs(x) >= t_ref[0])
+            & (gidx >= r_ref[0]) & (gidx < r_ref[1]))
+    m = mask.astype(jnp.int32)
+    pos, raw = C._block_prefix(m)
+
+    for p in range(BLK_ROWS):
+        kept_p = mask & (pos >= p * BLK_COLS) & (pos < (p + 1) * BLK_COLS)
+        sel_p = jnp.where(kept_p, pos - p * BLK_COLS, BLK_COLS)
+        row = C._stage_tile(jnp.where(kept_p, woff, 0), sel_p, BLK_COLS)
+
+        def write(row=row, p=p):
+            w_ref[p:p + 1, :] = row
+
+        if use_when and p > 0:
+            pl.when(raw > p * BLK_COLS)(write)
+            # rows for dead pages keep whatever was there; zero them so
+            # parity checks are clean
+
+            def zero(p=p):
+                w_ref[p:p + 1, :] = jnp.zeros((1, BLK_COLS), jnp.float32)
+
+            pl.when(raw <= p * BLK_COLS)(zero)
+        else:
+            write()
+
+
+def run_repair(xp, t, rng, bl, novf_cap, use_when, interpret=False):
+    import jax.experimental.pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    nrows = xp.shape[0]
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,
+        grid=(novf_cap,),
+        in_specs=[pl.BlockSpec((BLK_ROWS, BLK_COLS),
+                               lambda i, t, r, bl: (bl[i], 0))],
+        out_specs=[pl.BlockSpec((BLK_ROWS, BLK_COLS),
+                                lambda i, t, r, bl: (i, 0))],
+    )
+    (w,) = pl.pallas_call(
+        functools.partial(_repair_kernel, use_when),
+        grid_spec=grid_spec,
+        out_shape=[jax.ShapeDtypeStruct((novf_cap * BLK_ROWS, BLK_COLS),
+                                        jnp.float32)],
+        interpret=interpret,
+    )(t, rng, bl, xp)
+    return w
+
+
+def main():
+    dev = jax.devices()[0]
+    print(f"device: {dev}", flush=True)
+    rngnp = np.random.RandomState(0)
+    n = 1 << 22                                          # 4M, 4096 blocks
+    x = rngnp.standard_t(3, size=n).astype(np.float32)
+    # heavy blocks: make ~5% of blocks dense
+    hot = rngnp.choice(n // BLK, size=n // BLK // 20, replace=False)
+    xb = x.reshape(-1, BLK)
+    xb[hot] *= 50.0
+    x = jnp.asarray(xb.reshape(-1))
+
+    d = 0.02
+    k = int(n * d)
+    thresh = float(jnp.sort(jnp.abs(x))[-k])
+
+    xp, xflat, t, rng, _, nblocks = C._prep(x, thresh, None, None)
+    raw = np.asarray(jnp.sum(
+        (jnp.abs(xflat).reshape(-1, BLK) >= max(thresh, 1.17549435e-38)),
+        axis=1))
+    ovf = raw > C.CAPB_FAST
+    print(f"blocks={nblocks} overflow={int(ovf.sum())} "
+          f"max={int(raw.max())}", flush=True)
+
+    novf_cap = max(((nblocks // 8) + 7) // 8 * 8, 8)
+    bl_np = np.zeros(novf_cap, np.int32)
+    idxs = np.nonzero(ovf)[0]
+    assert idxs.size <= novf_cap
+    bl_np[:idxs.size] = idxs
+    bl = jnp.asarray(bl_np)
+
+    # oracle: wide kernel staging rows
+    w_wide, stored_w, raw_w = C._run_stage(xp, t, rng, BLK, nblocks, False,
+                                           frozenset())
+    w_wide = np.asarray(w_wide)
+
+    results = {}
+    for use_when in (True, False):
+        name = f"when={use_when}"
+        try:
+            fn = jax.jit(lambda xp, t, rng, bl, uw=use_when:
+                         run_repair(xp, t, rng, bl, novf_cap, uw))
+            w = np.asarray(fn(xp, t, rng, bl))
+        except Exception as e:
+            results[name] = f"FAILED: {e!r}"
+            print(f"{name}: FAILED {e!r}", flush=True)
+            continue
+        wr = w.reshape(novf_cap, BLK)
+        ok = True
+        for j, b in enumerate(idxs):
+            nb_s = int(min(raw[b], BLK))
+            got = wr[j][:nb_s]
+            want = w_wide[b][:nb_s]
+            if not np.array_equal(got, want):
+                ok = False
+                print(f"{name}: mismatch block {b}: "
+                      f"{got[:8]} vs {want[:8]}", flush=True)
+                break
+        # timing
+        for _ in range(2):
+            out = fn(xp, t, rng, bl)
+        jax.block_until_ready(out)
+        t0 = time.perf_counter()
+        for _ in range(10):
+            out = fn(xp, t, rng, bl)
+        jax.block_until_ready(out)
+        ms = (time.perf_counter() - t0) / 10 * 1e3
+        results[name] = {"parity": ok, "ms": round(ms, 3)}
+        print(f"{name}: parity={ok} ms={ms:.3f}", flush=True)
+
+    # reference timings at this size
+    t0 = time.perf_counter()
+    for _ in range(10):
+        out = C._run_stage(xp, t, rng, BLK, nblocks, False, frozenset())
+    jax.block_until_ready(out)
+    results["wide_ms"] = round((time.perf_counter() - t0) / 10 * 1e3, 3)
+    t0 = time.perf_counter()
+    for _ in range(10):
+        out = C._run_stage(xp, t, rng, C.CAPB_FAST, nblocks, False,
+                           frozenset())
+    jax.block_until_ready(out)
+    results["fast_ms"] = round((time.perf_counter() - t0) / 10 * 1e3, 3)
+    print("RESULT " + json.dumps(results), flush=True)
+
+
+if __name__ == "__main__":
+    main()

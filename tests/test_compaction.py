@@ -76,6 +76,33 @@ class TestCompactionParity:
         np.testing.assert_array_equal(gi, wi)
         np.testing.assert_array_equal(gv, wv)
 
+    def test_repair_branch_scattered_overflow(self):
+        """A few scattered overflowing blocks (0 < novf <= _novf_cap):
+        the repair-kernel branch, mixed 128/1024-wide staging layout."""
+        rng = np.random.RandomState(11)
+        n = 64 * BLK
+        x = rng.randn(n).astype(np.float32) * 0.1
+        for b in (3, 17, 40):            # ~5% of blocks, far over CAPB_FAST
+            x[b * BLK:(b + 1) * BLK] = rng.randn(BLK) * 10 + 20
+        (gv, gi, gc), (wv, wi, wc) = run_both(x, 1.0, 8 * BLK)
+        assert gc == wc
+        np.testing.assert_array_equal(gi, wi)
+        np.testing.assert_array_equal(gv, wv)
+
+    def test_wide_fallback_when_repair_list_overflows(self):
+        """More overflowing blocks than the repair-list capacity
+        (novf > _novf_cap): the full-width re-stage fallback."""
+        from oktopk_tpu.ops.compaction import _novf_cap
+
+        rng = np.random.RandomState(12)
+        n = 16 * BLK
+        assert _novf_cap(16) == 8
+        x = (rng.randn(n).astype(np.float32) * 10 + 20)   # all blocks dense
+        (gv, gi, gc), (wv, wi, wc) = run_both(x, 1.0, n)
+        assert gc == wc == n
+        np.testing.assert_array_equal(gi, wi)
+        np.testing.assert_array_equal(gv, wv)
+
     def test_range_restriction(self):
         rng = np.random.RandomState(3)
         x = rng.randn(3 * BLK).astype(np.float32)
@@ -115,6 +142,27 @@ class TestPackRegionsParity:
             jnp.asarray(x), t, b, R, cap, interpret=True)]
         wv, wi, wc = [np.asarray(a) for a in pack_by_region(
             jnp.asarray(x), jnp.abs(jnp.asarray(x)) >= t, b, R, cap)]
+        np.testing.assert_array_equal(gc, wc)
+        np.testing.assert_array_equal(gi, wi)
+        np.testing.assert_array_equal(gv, wv)
+
+    def test_repair_branch_with_straddling_boundary(self):
+        """An overflowing block that also contains a region boundary: the
+        straddle row must be fetched from the repaired (1024-wide) staging,
+        not the truncated fast row."""
+        from oktopk_tpu.ops.compaction import pack_by_region_pallas
+        from oktopk_tpu.ops.select import pack_by_region
+
+        rng = np.random.RandomState(13)
+        n = 16 * BLK
+        x = rng.randn(n).astype(np.float32) * 0.1
+        x[5 * BLK:6 * BLK] = rng.randn(BLK) * 10 + 20     # block 5 dense
+        # boundary inside the dense block, past the 128 fast-staged slots
+        b = jnp.asarray([0, 5 * BLK + 700, n], jnp.int32)
+        gv, gi, gc = [np.asarray(a) for a in pack_by_region_pallas(
+            jnp.asarray(x), 1.0, b, 2, 2 * BLK, interpret=True)]
+        wv, wi, wc = [np.asarray(a) for a in pack_by_region(
+            jnp.asarray(x), jnp.abs(jnp.asarray(x)) >= 1.0, b, 2, 2 * BLK)]
         np.testing.assert_array_equal(gc, wc)
         np.testing.assert_array_equal(gi, wi)
         np.testing.assert_array_equal(gv, wv)
