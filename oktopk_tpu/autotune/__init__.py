@@ -1,0 +1,39 @@
+"""Per-bucket algorithm/density autotuner.
+
+The sparse collectives only beat dense allreduce in the regime the fabric,
+gradient size, and density put them in (PAPERS.md: "On the Utility of
+Gradient Compression..." arXiv 2103.00543; SparCML's dynamic sparse/dense
+switching, arXiv 1802.08021). The repo holds both halves of the decision —
+an analytic α-β cost model (`utils/cost_model.py`) and a per-bucket
+registry/trainer (`collectives/registry.py`, `train/trainer.py`) — and this
+package connects them: the algorithm choice becomes a measured runtime
+decision per gradient bucket instead of a CLI flag.
+
+Pipeline (mirroring the paper's periodic threshold re-estimation cadence):
+
+1. `calibrate`  — fit ICI_ALPHA/ICI_BETA per fabric from a few timed probe
+   collectives at startup (least squares on the α-β allreduce law),
+   replacing the hard-coded `utils/cost_model.py` constants.
+2. `trial`      — time each candidate (algorithm, density) for K steps per
+   bucket on-device, reusing `collectives.api.build_allreduce_step`.
+3. `policy`     — cost-model prior orders the candidates, trial
+   measurements form the posterior; hysteresis + a re-tune period keep
+   decisions from thrashing jit recompilation.
+4. `journal`    — JSONL decision log (bucket, candidates, predicted vs
+   measured ms, chosen algo/density): the observability surface.
+"""
+
+from oktopk_tpu.autotune.calibrate import (  # noqa: F401
+    FabricCoefficients,
+    fit_alpha_beta,
+    probe_fabric,
+)
+from oktopk_tpu.autotune.journal import DecisionJournal, read_journal  # noqa: F401
+from oktopk_tpu.autotune.policy import (  # noqa: F401
+    Autotuner,
+    AutotunePolicy,
+    BucketPlan,
+    Candidate,
+    predict_ms,
+)
+from oktopk_tpu.autotune.trial import TrialRunner  # noqa: F401

@@ -1,0 +1,67 @@
+"""JSONL decision journal — the autotuner's observability surface.
+
+Every calibration and per-bucket decision appends one JSON line, so tuner
+quality is auditable after the fact (predicted vs measured ms per
+candidate, why a plan was kept or switched). The format is line-delimited
+JSON on purpose: it survives crashes mid-run (every line that made it to
+disk parses alone) and greps cleanly, like the reference's per-rank
+profiling logs (VGG/allreducer.py:702-703) but machine-readable.
+
+Schema (all events carry ``event`` and ``step``):
+
+  {"event": "calibration", "step": 0, "num_workers": 8,
+   "alpha": 1.1e-6, "beta": 9.8e-12, "sizes": [...], "times_ms": [...],
+   "residual": 0.02, "source": "measured" | "default"}
+
+  {"event": "decision", "step": 0, "bucket": 0, "n": 1182720,
+   "num_workers": 8,
+   "candidates": [{"algo": "dense", "density": 1.0,
+                   "predicted_ms": 3.1, "measured_ms": 2.9}, ...],
+   "chosen": {"algo": "oktopk", "density": 0.02},
+   "incumbent": {"algo": "dense", "density": 1.0} | null,
+   "reason": "trial" | "hold"}
+
+``reason`` is "hold" when hysteresis kept the incumbent despite a
+challenger measuring faster (within the hysteresis margin), "trial"
+otherwise.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, List, Optional
+
+
+class DecisionJournal:
+    """Append-only JSONL writer. ``path=None`` keeps entries in memory only
+    (tests, or callers that just want the plan)."""
+
+    def __init__(self, path: Optional[str] = None):
+        self.path = path
+        self.entries: List[Dict[str, Any]] = []
+        if path:
+            d = os.path.dirname(os.path.abspath(path))
+            os.makedirs(d, exist_ok=True)
+            # truncate: one journal per tuner lifetime; re-tunes append
+            with open(path, "w"):
+                pass
+
+    def record(self, event: str, **fields) -> Dict[str, Any]:
+        entry = {"event": event, **fields}
+        self.entries.append(entry)
+        if self.path:
+            with open(self.path, "a") as f:
+                f.write(json.dumps(entry) + "\n")
+        return entry
+
+
+def read_journal(path: str) -> List[Dict[str, Any]]:
+    """Parse a JSONL journal back into a list of entries."""
+    out = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                out.append(json.loads(line))
+    return out

@@ -1,0 +1,254 @@
+"""Plan selection: cost-model prior -> trial posterior, with hysteresis.
+
+The decision unit is the gradient bucket (`optim.distributed.
+bucket_partition`): each bucket independently picks a collective algorithm
+and density. Priors come from the α-β cost model with coefficients
+calibrated by `autotune.calibrate`; posteriors are the measured trial
+step times from `autotune.trial`. The chosen plan only changes when a
+challenger beats the incumbent's *fresh* measurement by more than the
+hysteresis margin — mirroring the paper's periodic threshold
+re-estimation cadence, and keeping borderline buckets from flip-flopping
+the jitted train step into recompilation every re-tune.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, List, Optional, Sequence, Tuple
+
+from oktopk_tpu.autotune.calibrate import (FabricCoefficients,
+                                           default_coefficients)
+from oktopk_tpu.autotune.journal import DecisionJournal
+from oktopk_tpu.utils.cost_model import (allgather_cost, allreduce_cost,
+                                         sparse_allreduce_cost, topk_cost)
+
+# Algorithms whose wire pattern is "local top-k, then allgather the
+# winners" — their comm volume scales as kP pairs (logs/algo_sweep.json
+# measured 2kP transmitted scalars for topkA), unlike oktopk's balanced
+# O(k) two-phase exchange.
+_ALLGATHER_FAMILY = ("topkA", "topkA2", "topkAopt", "gtopk", "gaussiank",
+                     "gaussiankconcat", "gaussiankSA", "topkSA", "topkDSA")
+
+
+@dataclasses.dataclass(frozen=True)
+class Candidate:
+    """One (algorithm, density) point in the search space. ``density`` is
+    1.0 for dense (ignored by the algorithm, kept for the journal)."""
+
+    algo: str
+    density: float = 1.0
+
+    def key(self) -> Tuple[str, float]:
+        return (self.algo, self.density)
+
+    def as_dict(self):
+        return {"algo": self.algo, "density": self.density}
+
+
+@dataclasses.dataclass(frozen=True)
+class BucketPlan:
+    """The tuner's decision for one gradient bucket."""
+
+    bucket: int                  # bucket index (reverse-layer order)
+    n: int                       # flat element count of the bucket
+    algo: str
+    density: float
+    predicted_ms: float          # cost-model prior of the chosen candidate
+    measured_ms: float           # trial posterior of the chosen candidate
+
+    def key(self) -> Tuple[str, float]:
+        return (self.algo, self.density)
+
+    def as_dict(self):
+        return dataclasses.asdict(self)
+
+
+def predict_ms(algo: str, density: float, n: int, num_workers: int,
+               coeffs: FabricCoefficients) -> float:
+    """α-β cost-model prior for one candidate, in milliseconds.
+
+    dense: ring allreduce of n elements. oktopk: local selection +
+    the paper's two-phase O(k) exchange. The allgather family: local
+    selection + ring allgather of every worker's 2k-scalar (index, value)
+    winners. Selection cost uses the sort-free γ·n estimate shared by all
+    sparse candidates — the model only needs to rank, the trial phase
+    measures.
+    """
+    a, b = coeffs.alpha, coeffs.beta
+    p = max(1, num_workers)
+    if algo == "dense":
+        if p == 1:
+            # same degenerate (1, n) law the P=1 calibration fits: alpha
+            # is the dispatch floor, beta the per-element memory pass —
+            # the ring formula would predict exactly 0 for every n
+            from oktopk_tpu.autotune.calibrate import _design_row
+            ca, cb = _design_row(n, p)
+            return (ca * a + cb * b) * 1e3
+        return allreduce_cost(n, p, a, b) * 1e3
+    k = max(1, int(density * n))
+    sel = topk_cost(n)
+    if algo == "oktopk":
+        return (sel + sparse_allreduce_cost(k, p, a, b)) * 1e3
+    if algo in _ALLGATHER_FAMILY:
+        return (sel + allgather_cost(2 * k, p, a, b)) * 1e3
+    raise ValueError(f"no cost model for algorithm {algo!r}")
+
+
+@dataclasses.dataclass(frozen=True)
+class AutotunePolicy:
+    """Decision knobs (see TrainConfig.autotune_* for the CLI surface)."""
+
+    candidates: Tuple[Candidate, ...]
+    hysteresis: float = 0.15       # challenger must win by this fraction
+    retune_every: int = 0          # steps between re-tunes; 0 = tune once
+    max_trials: int = 0            # 0 = trial every candidate; else only
+    # the top-``max_trials`` by cost-model prior are measured (prior
+    # pruning — the "cost-model prior -> trial posterior" funnel)
+
+    def __post_init__(self):
+        if not self.candidates:
+            raise ValueError("autotune needs at least one candidate")
+        if not 0.0 <= self.hysteresis < 1.0:
+            raise ValueError(
+                f"hysteresis must be in [0, 1), got {self.hysteresis}")
+
+    def decide(self, bucket: int, n: int, num_workers: int,
+               coeffs: FabricCoefficients,
+               measure: Callable[[str, int, float], float],
+               incumbent: Optional[BucketPlan] = None,
+               journal: Optional[DecisionJournal] = None,
+               step: int = 0) -> BucketPlan:
+        """Pick the plan for one bucket; journals the full evidence."""
+        scored = [(predict_ms(c.algo, c.density, n, num_workers, coeffs), c)
+                  for c in self.candidates]
+        scored.sort(key=lambda pc: pc[0])
+        trialed = scored
+        if self.max_trials > 0:
+            trialed = scored[:self.max_trials]
+            # the incumbent is always re-measured: hysteresis compares
+            # against its FRESH time, not a stale one
+            if incumbent is not None and not any(
+                    c.key() == incumbent.key() for _, c in trialed):
+                trialed = trialed + [
+                    (p, c) for p, c in scored if c.key() == incumbent.key()]
+        rows = [{"algo": c.algo, "density": c.density,
+                 "predicted_ms": pred,
+                 "measured_ms": measure(c.algo, n, c.density)}
+                for pred, c in trialed]
+        skipped = [{"algo": c.algo, "density": c.density,
+                    "predicted_ms": pred, "measured_ms": None}
+                   for pred, c in scored[len(trialed):]
+                   if not any(r["algo"] == c.algo
+                              and r["density"] == c.density for r in rows)]
+        best = min(rows, key=lambda r: r["measured_ms"])
+        reason = "trial"
+        chosen = best
+        if incumbent is not None:
+            inc_fresh = next((r for r in rows
+                              if (r["algo"], r["density"]) ==
+                              incumbent.key()), None)
+            if inc_fresh is not None and (
+                    best["measured_ms"]
+                    >= inc_fresh["measured_ms"] * (1.0 - self.hysteresis)):
+                chosen, reason = inc_fresh, "hold"
+        plan = BucketPlan(bucket=bucket, n=n, algo=chosen["algo"],
+                          density=chosen["density"],
+                          predicted_ms=chosen["predicted_ms"],
+                          measured_ms=chosen["measured_ms"])
+        if journal is not None:
+            journal.record(
+                "decision", step=step, bucket=bucket, n=n,
+                num_workers=num_workers, candidates=rows + skipped,
+                chosen={"algo": plan.algo, "density": plan.density},
+                incumbent=(None if incumbent is None else
+                           {"algo": incumbent.algo,
+                            "density": incumbent.density}),
+                reason=reason)
+        return plan
+
+
+def make_candidates(algos: Sequence[str],
+                    densities: Sequence[float]) -> Tuple[Candidate, ...]:
+    """Cross sparse algorithms with the density grid; dense gets the single
+    density-1.0 point."""
+    out: List[Candidate] = []
+    for a in algos:
+        if a == "dense":
+            out.append(Candidate("dense", 1.0))
+        else:
+            for d in densities:
+                out.append(Candidate(a, float(d)))
+    return tuple(out)
+
+
+class Autotuner:
+    """Orchestrates calibrate -> trial -> policy over a bucket list.
+
+    ``bucket_sizes`` are the flat element counts from
+    ``optim.distributed.bucket_sizes`` (reverse-layer order, like the
+    per-bucket SparseState). The tuner owns the decision journal and the
+    current plan list; the trainer consults ``plans`` when (re)building
+    its step and calls ``should_retune``/``tune`` on the configured
+    cadence.
+    """
+
+    def __init__(self, bucket_sizes: Sequence[int], num_workers: int,
+                 policy: AutotunePolicy, runner,
+                 coeffs: Optional[FabricCoefficients] = None,
+                 journal: Optional[DecisionJournal] = None,
+                 calibration_sizes: Optional[Sequence[int]] = None):
+        self.bucket_sizes = [int(s) for s in bucket_sizes]
+        self.num_workers = int(num_workers)
+        self.policy = policy
+        self.runner = runner
+        self.journal = journal if journal is not None else DecisionJournal()
+        self.coeffs = coeffs
+        self.calibration_sizes = calibration_sizes
+        self.plans: Optional[List[BucketPlan]] = None
+        self.last_tune_step: Optional[int] = None
+
+    def calibrate(self, mesh=None, step: int = 0) -> FabricCoefficients:
+        """Fit α-β from probe collectives (falls back to the cost-model
+        defaults when no mesh is available to probe)."""
+        from oktopk_tpu.autotune.calibrate import (DEFAULT_PROBE_SIZES,
+                                                   probe_fabric)
+
+        if mesh is not None:
+            sizes = tuple(self.calibration_sizes or DEFAULT_PROBE_SIZES)
+            self.coeffs = probe_fabric(mesh, sizes=sizes)
+        elif self.coeffs is None:
+            self.coeffs = default_coefficients()
+        self.journal.record("calibration", step=step,
+                            num_workers=self.num_workers,
+                            **self.coeffs.as_dict())
+        return self.coeffs
+
+    def should_retune(self, step: int) -> bool:
+        if self.plans is None:
+            return True
+        if self.policy.retune_every <= 0:
+            return False
+        return step - (self.last_tune_step or 0) >= self.policy.retune_every
+
+    def tune(self, step: int = 0, mesh=None) -> List[BucketPlan]:
+        """One full trial pass over every bucket. Returns the new plan
+        list; ``plans_changed`` against the previous one tells the caller
+        whether the train step must be rebuilt."""
+        if self.coeffs is None:
+            self.calibrate(mesh=mesh, step=step)
+        old = self.plans
+        self.plans = [
+            self.policy.decide(
+                bi, n, self.num_workers, self.coeffs, self.runner.measure,
+                incumbent=(old[bi] if old is not None else None),
+                journal=self.journal, step=step)
+            for bi, n in enumerate(self.bucket_sizes)]
+        self.last_tune_step = step
+        return self.plans
+
+    @staticmethod
+    def plans_changed(new: Optional[Sequence[BucketPlan]],
+                      old: Optional[Sequence[BucketPlan]]) -> bool:
+        if old is None or new is None:
+            return old is not new
+        return [p.key() for p in new] != [p.key() for p in old]

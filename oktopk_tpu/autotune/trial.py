@@ -1,0 +1,101 @@
+"""Trial phase: time candidate (algorithm, density) pairs on-device.
+
+Each trial builds the same jitted collective program the training step
+would run (``collectives.api.build_allreduce_step``) at the bucket's size,
+feeds it synthetic N(0,1) gradients, and times K steps via the honest
+host-fetch sync (``collectives.api.time_allreduce_step``). The measured
+median per-step ms is the policy's posterior over candidates.
+
+Compiled trial programs are memoised per (algo, n, density) — jit is the
+expensive part — but every ``measure`` call re-TIMES the cached program,
+so a re-tune sees the fabric as it is now, not as it was at startup
+(`invalidate()` additionally drops the compiled programs, e.g. after an
+elastic resize changes the mesh).
+
+Fake-timing injection (``fake_ms``) replaces the device entirely — the
+CPU test suite verifies policy behaviour (crossovers, hysteresis, journal
+schema) against a synthetic fabric without a TPU, per the tier-1
+``JAX_PLATFORMS=cpu`` contract.
+"""
+
+from __future__ import annotations
+
+import statistics
+from typing import Callable, Dict, Optional, Tuple
+
+import numpy as np
+
+from oktopk_tpu.config import OkTopkConfig
+
+
+class TrialRunner:
+    """Times candidate collectives over a mesh (or a fake fabric).
+
+    ``fake_ms(algo, n, density) -> ms`` short-circuits the device path.
+    ``base_cfg`` carries the algorithm knobs (cadences, wire dtype, ...)
+    every trial shares; n/density are overridden per candidate.
+    """
+
+    def __init__(self, mesh=None, axis_name: str = "data",
+                 trial_steps: int = 3, seed: int = 0,
+                 base_cfg: Optional[OkTopkConfig] = None,
+                 fake_ms: Optional[Callable[[str, int, float], float]] = None):
+        if mesh is None and fake_ms is None:
+            raise ValueError("TrialRunner needs a mesh or a fake_ms injector")
+        self.mesh = mesh
+        self.axis_name = axis_name
+        self.trial_steps = max(1, int(trial_steps))
+        self.seed = seed
+        self.base_cfg = base_cfg or OkTopkConfig()
+        self.fake_ms = fake_ms
+        self._cache: Dict[Tuple[str, int, float], float] = {}
+        self._grads: Dict[int, object] = {}
+
+    @property
+    def num_workers(self) -> int:
+        if self.mesh is None:
+            return self.base_cfg.num_workers or 1
+        return int(np.prod([self.mesh.shape[a] for a in (self.axis_name,)]))
+
+    def invalidate(self):
+        """Drop memoised compiled programs (e.g. after the mesh changed)."""
+        self._cache.clear()
+        self._grads.clear()
+
+    def measure(self, algo: str, n: int, density: float) -> float:
+        """Median per-step ms of ``algo`` on an n-element bucket."""
+        if self.fake_ms is not None:
+            return float(self.fake_ms(algo, int(n), float(density)))
+        return self._measure_real(algo, int(n), float(density))
+
+    def _bucket_grads(self, n: int):
+        import jax.numpy as jnp
+
+        if n not in self._grads:
+            rng = np.random.RandomState(self.seed)
+            self._grads[n] = jnp.asarray(
+                rng.randn(self.num_workers, n).astype(np.float32))
+        return self._grads[n]
+
+    def _measure_real(self, algo: str, n: int, density: float) -> float:
+        from oktopk_tpu.collectives.api import (batched_init_state,
+                                                build_allreduce_step,
+                                                time_allreduce_step)
+
+        # dense ignores density; pin it so the program cache key is shared
+        # across whatever densities the candidate list carries
+        d = 1.0 if algo == "dense" else density
+        key = (algo, n, d)
+        if key not in self._cache:
+            cfg = self.base_cfg.replace(
+                n=n, num_workers=self.num_workers, density=min(d, 1.0),
+                warmup_steps=0, density_schedule=None)
+            step = build_allreduce_step(algo, cfg, self.mesh,
+                                        axis_name=self.axis_name,
+                                        warmup=False)
+            self._cache[key] = (step, batched_init_state(cfg))
+        step, state = self._cache[key]
+        times_ms, _ = time_allreduce_step(step, state=state,
+                                          grads=self._bucket_grads(n),
+                                          iters=self.trial_steps)
+        return float(statistics.median(times_ms))

@@ -18,6 +18,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from oktopk_tpu.collectives.registry import get_algorithm
 from oktopk_tpu.collectives.state import SparseState, init_state
+from oktopk_tpu.comm import compat
 from oktopk_tpu.config import OkTopkConfig
 
 
@@ -53,10 +54,39 @@ def build_allreduce_step(name: str, cfg: OkTopkConfig, mesh: Mesh,
         out, s2 = algo(g1, s1, cfg, axis_name)
         return out[None], jax.tree.map(lambda x: x[None], s2)
 
-    mapped = jax.shard_map(shard_fn, mesh=mesh,
-                           in_specs=(spec, spec), out_specs=(spec, spec),
-                           check_vma=check_vma)
+    mapped = compat.shard_map(shard_fn, mesh=mesh,
+                              in_specs=(spec, spec), out_specs=(spec, spec),
+                              check_vma=check_vma)
     return jax.jit(mapped)
+
+
+def time_allreduce_step(step_fn, grads, state, iters: int = 3,
+                        warmup_iters: int = 1):
+    """Honest per-step wall times of a ``build_allreduce_step`` program.
+
+    The autotuner's trial phase (autotune/trial.py) needs step times it can
+    compare across algorithms; each timed call ends with a host fetch of
+    one result scalar — through the remote-device tunnel
+    ``block_until_ready`` can return before execution finishes, so the
+    fetch is the only honest synchronization point (see bench.py).
+
+    Returns ``(times_ms, state)`` with ``len(times_ms) == iters``;
+    ``warmup_iters`` untimed calls first absorb compilation.
+    """
+    import time
+
+    import numpy as np
+
+    for _ in range(warmup_iters):
+        out, state = step_fn(grads, state)
+        float(np.asarray(out[0, 0]))
+    times_ms = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        out, state = step_fn(grads, state)
+        float(np.asarray(out[0, 0]))
+        times_ms.append((time.perf_counter() - t0) * 1e3)
+    return times_ms, state
 
 
 @partial(jax.jit, static_argnames=())

@@ -13,10 +13,12 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from oktopk_tpu.comm import compat
+
 
 def axis_size(axis_name: str):
     """World size along an axis (reference: comm.size)."""
-    return lax.axis_size(axis_name)
+    return compat.axis_size(axis_name)
 
 
 def axis_rank(axis_name: str):
@@ -67,7 +69,7 @@ def ppermute_shift(x, axis_name: str, shift: int = 1):
     """Ring shift by ``shift`` positions (reference's rotated dst/src
     schedule, VGG/allreducer.py:246-251, is exactly P-1 such shifts; also the
     building block for gtopk's tree exchange and ring attention)."""
-    n = lax.axis_size(axis_name)
+    n = compat.axis_size(axis_name)
     perm = [(i, (i + shift) % n) for i in range(n)]
     return lax.ppermute(x, axis_name, perm)
 
@@ -80,7 +82,7 @@ def pvary_like(tree, ref):
     over the collective axis only), while carried state matches the
     gradient's full vma — which under a composed mesh (data x pipe, data x
     seq) spans MORE than the collective axis."""
-    vma = getattr(jax.typeof(jnp.asarray(ref)), "vma", frozenset())
+    vma = compat.typeof_vma(jnp.asarray(ref))
     return jax.tree.map(lambda x: pvary_to(jnp.asarray(x), vma), tree)
 
 
@@ -92,20 +94,14 @@ def carry_vma(*arrays, axis_name):
     vma = {axis_name}
     for a in arrays:
         for leaf in jax.tree.leaves(a):
-            vma |= set(getattr(jax.typeof(leaf), "vma", frozenset()))
+            vma |= set(compat.typeof_vma(leaf))
     return tuple(sorted(vma))
 
 
 def pvary_to(x, vma):
     """Mark ``x`` varying over exactly the axes in ``vma`` it isn't yet."""
-    missing = tuple(sorted(set(vma)
-                           - set(getattr(jax.typeof(x), "vma",
-                                         frozenset()))))
-    if not missing:
-        return x
-    if hasattr(lax, "pcast"):
-        return lax.pcast(x, missing, to="varying")
-    return lax.pvary(x, missing)
+    missing = tuple(sorted(set(vma) - set(compat.typeof_vma(x))))
+    return compat.pvary(x, missing)
 
 
 def ppermute_pair(x, axis_name: str, distance: int):
@@ -113,6 +109,6 @@ def ppermute_pair(x, axis_name: str, distance: int):
     gtopk's recursive-halving tree, VGG/allreducer.py:76-172, expressed as a
     symmetric exchange so every rank ends with the same merged result and the
     final Bcast at VGG/allreducer.py:162 is unnecessary)."""
-    n = lax.axis_size(axis_name)
+    n = compat.axis_size(axis_name)
     perm = [(i, i ^ distance) for i in range(n)]
     return lax.ppermute(x, axis_name, perm)

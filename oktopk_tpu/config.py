@@ -306,6 +306,30 @@ class TrainConfig:
     # bucketing, VGG/allreducer.py:27,272-330). 1 = whole-model flat.
     num_buckets: int = 1
 
+    # ---- per-bucket algorithm/density autotuning (autotune/) ----------
+    # When True the trainer runs calibrate -> trial -> policy before the
+    # first step (and again on the retune cadence) and builds each
+    # bucket's collective from the resulting plan; ``compressor`` becomes
+    # the fallback for buckets the tuner has not planned yet.
+    autotune: bool = False
+    # Candidate algorithms (registry names). Sparse ones are crossed with
+    # ``autotune_densities``; "dense" is the single density-1.0 point.
+    autotune_candidates: Tuple[str, ...] = ("dense", "oktopk")
+    # Density grid for sparse candidates; () = just ``density``.
+    autotune_densities: Tuple[float, ...] = ()
+    # Timed steps per candidate per bucket in the trial phase.
+    autotune_trial_steps: int = 3
+    # Steps between re-tunes; 0 = tune once before the first step.
+    autotune_retune_every: int = 0
+    # A challenger must beat the incumbent's fresh measurement by this
+    # fraction to flip a bucket's plan (anti-thrash dead zone: a flip
+    # rebuilds + recompiles the jitted train step).
+    autotune_hysteresis: float = 0.15
+    # Trial only the top-N candidates by cost-model prior (0 = all).
+    autotune_max_trials: int = 0
+    # JSONL decision-journal path; None keeps the journal in memory.
+    autotune_journal: Optional[str] = None
+
     def experiment_slug(self) -> str:
         """Reference experiment naming convention
         (VGG/main_trainer.py:163-166)."""

@@ -46,8 +46,12 @@ dispatches (``lax.switch``) on the overflow census:
   * more                       -> the capb=1024 kernel over everything
     (can never drop anything), as before.
 
-All paths reproduce the portable result bit-for-bit (asserted in
-tests/test_compaction.py and on real hardware in tests/test_tpu_hw.py).
+All paths reproduce the portable result bit-for-bit in interpret mode
+(asserted in tests/test_compaction.py); tests/test_tpu_hw.py mirrors
+them for real-chip Mosaic compilation, but the last recorded on-chip pass
+(logs/tpu_hw_status.json) predates the repair branch — re-run
+``OKTOPK_TPU_HW=1`` on a live relay to refresh the stamp before trusting
+the repair kernel + _materialize_het on silicon.
 
 The reference's analogous code is the boolean-mask nonzero select
 (``compressbythreshold``, VGG/compression.py:122-142) — a cheap op on GPU,
@@ -62,6 +66,8 @@ import os
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+from oktopk_tpu.comm import compat
 
 
 def _interpret_default() -> bool:
@@ -191,8 +197,8 @@ def _run_stage(xp, t, rng, capb, nblocks, interpret, vma):
     from jax.experimental.pallas import tpu as pltpu
 
     out_shapes = [
-        jax.ShapeDtypeStruct((nblocks, capb), jnp.float32, vma=vma),
-        jax.ShapeDtypeStruct((nblocks, BLK_COLS), jnp.int32, vma=vma),
+        compat.shape_dtype_struct((nblocks, capb), jnp.float32, vma=vma),
+        compat.shape_dtype_struct((nblocks, BLK_COLS), jnp.int32, vma=vma),
     ]
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
@@ -263,8 +269,8 @@ def _run_repair(xp, t, rng, bl, novf_cap, interpret, vma):
     (w,) = pl.pallas_call(
         _repair_kernel,
         grid_spec=grid_spec,
-        out_shape=[jax.ShapeDtypeStruct((novf_cap * BLK_ROWS, BLK_COLS),
-                                        jnp.float32, vma=vma)],
+        out_shape=[compat.shape_dtype_struct((novf_cap * BLK_ROWS, BLK_COLS),
+                                             jnp.float32, vma=vma)],
         interpret=interpret,
     )(t, rng, bl, xp)
     return w
@@ -417,15 +423,12 @@ def _prep(x, thresh, lo, hi):
 def _vma_of(xp):
     # under shard_map's VMA tracking the outputs vary over the same mesh
     # axes as the input shard, and every operand must agree
-    try:
-        return jax.typeof(xp).vma
-    except Exception:
-        return frozenset()
+    return compat.typeof_vma(xp)
 
 
 def _pvary_to(arr, vma):
-    missing = tuple(vma - jax.typeof(arr).vma)
-    return jax.lax.pvary(arr, missing) if missing else arr
+    missing = tuple(vma - compat.typeof_vma(arr))
+    return compat.pvary(arr, missing)
 
 
 @functools.partial(jax.jit, static_argnames=("cap", "interpret"))

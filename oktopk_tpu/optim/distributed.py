@@ -19,7 +19,7 @@ single allreduce.
 
 from __future__ import annotations
 
-from typing import Any, Callable, Optional
+from typing import Any, Callable, Optional, Sequence, Union
 
 import flax.struct
 import jax
@@ -29,6 +29,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from oktopk_tpu.collectives.registry import get_algorithm
 from oktopk_tpu.collectives.state import SparseState, init_state
+from oktopk_tpu.comm import compat
 from oktopk_tpu.config import OkTopkConfig
 
 
@@ -124,7 +125,7 @@ def build_sparse_grad_step(
     optimizer,
     cfg: OkTopkConfig,
     mesh: Mesh,
-    compressor: str = "oktopk",
+    compressor: Union[str, Sequence[str]] = "oktopk",
     axis_name: str = "data",
     nsteps_update: int = 1,
     grad_clip: Optional[float] = None,
@@ -132,6 +133,7 @@ def build_sparse_grad_step(
     profile_norm: bool = False,
     momentum_correction: float = 0.0,
     num_buckets: int = 1,
+    bucket_densities: Optional[Sequence[float]] = None,
 ):
     """Build the jitted distributed train step.
 
@@ -159,6 +161,12 @@ def build_sparse_grad_step(
         the last layers' grads, so XLA can overlap its collective with the
         remaining backward. Selection becomes per-bucket top-k, exactly
         the reference's per-merged-group compression.
+      compressor: one registry name for every bucket, or a sequence of
+        ``num_buckets`` names — the per-bucket plan the autotuner
+        (autotune/policy.py) produces. All variants trace into ONE jitted
+        program; changing the plan means rebuilding the step.
+      bucket_densities: optional per-bucket density overrides, parallel to
+        the compressor sequence (the autotuner's chosen densities).
 
     Returns ``step(state: DistTrainState, batch, rng) -> (state, metrics)``.
     ``batch`` leaves are [num_workers * nsteps_update * mb, ...] and get
@@ -166,7 +174,17 @@ def build_sparse_grad_step(
     """
     from oktopk_tpu.ops.compaction import resolve_use_pallas
     cfg = resolve_use_pallas(cfg, mesh)
-    algo = get_algorithm(compressor, warmup=warmup)
+    nb = max(1, num_buckets)
+    names = ([compressor] * nb if isinstance(compressor, str)
+             else list(compressor))
+    if len(names) != nb:
+        raise ValueError(
+            f"compressor plan has {len(names)} entries for {nb} buckets")
+    if bucket_densities is not None and len(bucket_densities) != nb:
+        raise ValueError(
+            f"bucket_densities has {len(bucket_densities)} entries for "
+            f"{nb} buckets")
+    algos = [get_algorithm(nm, warmup=warmup) for nm in names]
 
     def shard_fn(state: DistTrainState, batch, rng):
         rng = jax.random.fold_in(rng, lax.axis_index(axis_name))
@@ -221,12 +239,17 @@ def build_sparse_grad_step(
         eps_num = eps_den = jnp.asarray(0.0, jnp.float32)
         for bi, idxs in enumerate(buckets):
             flat = jnp.concatenate([leaves[i].reshape(-1) for i in idxs])
-            cfg_b = cfg if single else cfg.replace(n=int(flat.size))
+            over = {}
+            if not single:
+                over["n"] = int(flat.size)
+            if bucket_densities is not None:
+                over["density"] = float(bucket_densities[bi])
+            cfg_b = cfg.replace(**over) if over else cfg
             sp = jax.tree.map(lambda x: x[0], states_in[bi])
             if momentum_correction:
                 flat = momentum_correction * moms_in[bi][0] + flat
                 new_moms.append(flat[None])
-            reduced, sp = algo(flat, sp, cfg_b, axis_name)
+            reduced, sp = algos[bi](flat, sp, cfg_b, axis_name)
             off = 0
             for i in idxs:
                 sz = leaves[i].size
@@ -279,7 +302,7 @@ def build_sparse_grad_step(
         params=P(), model_state=P(), opt_state=P(),
         sparse_state=P(axis_name),
         local_momentum=P(axis_name) if momentum_correction else None)
-    mapped = jax.shard_map(
+    mapped = compat.shard_map(
         shard_fn, mesh=mesh,
         in_specs=(state_specs, P(axis_name), P()),
         out_specs=(state_specs, P()),

@@ -37,6 +37,8 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
+from oktopk_tpu.comm import compat
+
 from oktopk_tpu.models.bert import BertConfig
 from oktopk_tpu.parallel.bert_seq import _dense, _layer_norm
 
@@ -123,7 +125,7 @@ def moe_ffn(experts_local, gate, x, mcfg: MoEConfig, axis_name,
     semantics require globally averaged STATS — a mean of per-shard aux
     values is a different objective (mean of products != product of
     means)."""
-    Pn = lax.axis_size(axis_name)
+    Pn = compat.axis_size(axis_name)
     E = mcfg.num_experts
     e_local = experts_local["wi"].shape[0]
     assert e_local * Pn == E, (e_local, Pn, E)
@@ -277,9 +279,9 @@ def build_moe_loss(cfg: BertConfig, mcfg: MoEConfig, mesh: Mesh,
         return bert_moe_loss(moe_layers, shared, batch, cfg, mcfg,
                              axis_name, data_axis=data_axis)
 
-    mapped = jax.shard_map(shard_fn, mesh=mesh,
-                           in_specs=(P(axis_name), P(), batch_spec),
-                           out_specs=P())
+    mapped = compat.shard_map(shard_fn, mesh=mesh,
+                              in_specs=(P(axis_name), P(), batch_spec),
+                              out_specs=P())
     return jax.jit(mapped)
 
 
@@ -352,7 +354,7 @@ def build_moe_sparse_train_step(cfg: BertConfig, mcfg: MoEConfig,
                  "comm_volume": lax.pmean(vol, (data_axis, axis_name))})
 
     de = P(data_axis, axis_name)
-    mapped = jax.shard_map(
+    mapped = compat.shard_map(
         shard_fn, mesh=mesh,
         in_specs=((de, P(data_axis)), (de, P(data_axis)),
                   (de, P(data_axis)), P((data_axis, axis_name))),

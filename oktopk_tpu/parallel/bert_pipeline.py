@@ -31,6 +31,8 @@ import numpy as np
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
+from oktopk_tpu.comm import compat
+
 from oktopk_tpu.models.bert_staged import StagedBertPretrain
 from oktopk_tpu.parallel.pipeline import gpipe_apply
 from oktopk_tpu.train import losses
@@ -114,7 +116,7 @@ def build_pipeline_loss(staged: StagedBertPretrain, mesh: Mesh,
     batch_specs = {k: spec_b for k in ("input_ids", "token_type_ids",
                                        "attention_mask", "mlm_labels",
                                        "nsp_labels")}
-    mapped = jax.shard_map(
+    mapped = compat.shard_map(
         shard_fn, mesh=mesh,
         in_specs=(P("pipe"), P(), batch_specs, P()),
         out_specs=P())
@@ -195,7 +197,7 @@ def build_pipeline_train_step(staged: StagedBertPretrain, mesh: Mesh,
     batch_specs = {k: spec_b for k in ("input_ids", "token_type_ids",
                                        "attention_mask", "mlm_labels",
                                        "nsp_labels")}
-    mapped = jax.shard_map(
+    mapped = compat.shard_map(
         shard_fn, mesh=mesh,
         in_specs=(P("pipe"), P(), (P("pipe"), P()), batch_specs, P()),
         out_specs=(P("pipe"), P(), (P("pipe"), P()), P()))
@@ -324,7 +326,7 @@ def build_pipeline_sparse_train_step(staged: StagedBertPretrain, mesh: Mesh,
             # reduce only over axes the value actually varies on (the loss
             # is already pipe-invariant via the pipeline's final broadcast)
             ax = tuple(a for a in ("data", "pipe")
-                       if a in jax.typeof(x).vma)
+                       if a in compat.typeof_vma(x))
             return lax.pmean(x, ax) if ax else x
 
         metrics = {"loss": pmean_varying(loss),
@@ -338,7 +340,7 @@ def build_pipeline_sparse_train_step(staged: StagedBertPretrain, mesh: Mesh,
                                        "attention_mask", "mlm_labels",
                                        "nsp_labels")}
     dp2 = P("data", "pipe")
-    mapped = jax.shard_map(
+    mapped = compat.shard_map(
         shard_fn, mesh=mesh,
         in_specs=((dp2, P("data")), (dp2, P("data")),
                   (dp2, P("data")), batch_specs, P()),

@@ -32,6 +32,8 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
+from oktopk_tpu.comm import compat
+
 from oktopk_tpu.models.bert import BertConfig
 from oktopk_tpu.parallel.ring_attention import ring_attention
 from oktopk_tpu.train import losses  # noqa: F401  (doc cross-ref)
@@ -276,7 +278,7 @@ def build_seq_sparse_train_step(cfg: BertConfig, mesh: Mesh, optimizer,
                 lax.pmean(loss, data_axis))
 
     spec_d = P(data_axis)
-    mapped = jax.shard_map(
+    mapped = compat.shard_map(
         shard_fn, mesh=mesh,
         in_specs=(spec_d, spec_d, spec_d, batch_specs),
         out_specs=(spec_d, spec_d, spec_d, P()),
@@ -304,6 +306,6 @@ def build_seq_loss(cfg: BertConfig, mesh: Mesh,
         return bert_seq_loss(params, batch, cfg, axis_name,
                              data_axis=data_axis)
 
-    mapped = jax.shard_map(shard_fn, mesh=mesh,
-                           in_specs=(P(), batch_specs), out_specs=P())
+    mapped = compat.shard_map(shard_fn, mesh=mesh,
+                              in_specs=(P(), batch_specs), out_specs=P())
     return jax.jit(mapped)

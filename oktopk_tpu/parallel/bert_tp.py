@@ -26,6 +26,8 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
+from oktopk_tpu.comm import compat
+
 from oktopk_tpu.models.bert import BertConfig
 from oktopk_tpu.parallel.bert_seq import _dense, _layer_norm
 
@@ -210,9 +212,9 @@ def build_tp_loss(cfg: BertConfig, mesh: Mesh, axis_name: str = "model"):
     def shard_fn(tp_layers, shared, batch):
         return bert_tp_loss(tp_layers, shared, batch, cfg, axis_name)
 
-    mapped = jax.shard_map(shard_fn, mesh=mesh,
-                           in_specs=(P(axis_name), P(), P()),
-                           out_specs=P())
+    mapped = compat.shard_map(shard_fn, mesh=mesh,
+                              in_specs=(P(axis_name), P(), P()),
+                              out_specs=P())
     return jax.jit(mapped)
 
 
@@ -245,10 +247,10 @@ def build_tp_train_step(cfg: BertConfig, mesh: Mesh, optimizer,
         return lead(tp_local), shared, lead(opt_tp_l), opt_sh, loss
 
     m = P(axis_name)
-    mapped = jax.shard_map(shard_fn, mesh=mesh,
-                           in_specs=(m, P(), m, P(), P()),
-                           out_specs=(m, P(), m, P(), P()),
-                           check_vma=True)
+    mapped = compat.shard_map(shard_fn, mesh=mesh,
+                              in_specs=(m, P(), m, P(), P()),
+                              out_specs=(m, P(), m, P(), P()),
+                              check_vma=True)
     return jax.jit(mapped, donate_argnums=(0, 1, 2, 3))
 
 
@@ -355,7 +357,7 @@ def build_tp_sparse_train_step(cfg: BertConfig, mesh: Mesh, optimizer,
 
         def pmean_varying(x):
             ax = tuple(a for a in (data_axis, axis_name)
-                       if a in jax.typeof(x).vma)
+                       if a in compat.typeof_vma(x))
             return lax.pmean(x, ax) if ax else x
 
         metrics = {"loss": pmean_varying(loss),
@@ -369,7 +371,7 @@ def build_tp_sparse_train_step(cfg: BertConfig, mesh: Mesh, optimizer,
     batch_specs = {k: d for k in ("input_ids", "token_type_ids",
                                   "attention_mask", "mlm_labels",
                                   "nsp_labels")}
-    mapped = jax.shard_map(
+    mapped = compat.shard_map(
         shard_fn, mesh=mesh,
         in_specs=((dm, d), (dm, d), (dm, d), batch_specs),
         out_specs=((dm, d), (dm, d), (dm, d), P()),

@@ -31,6 +31,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from oktopk_tpu.comm import compat
+
 from oktopk_tpu.comm.primitives import carry_vma as _carry_vma
 from oktopk_tpu.comm.primitives import pvary_to as _pvary_to
 
@@ -46,7 +48,7 @@ def _bcast_from_last(x, axis_name):
     axis size — every rank's copy of the SAME downstream loss would be
     summed. The correct transpose of "broadcast from last" is "deliver the
     cotangent to last, zero elsewhere"."""
-    P = lax.axis_size(axis_name)
+    P = compat.axis_size(axis_name)
     s = lax.axis_index(axis_name)
     return lax.psum(jnp.where(s == P - 1, x, jnp.zeros_like(x)), axis_name)
 
@@ -56,7 +58,7 @@ def _bcast_from_last_fwd(x, axis_name):
 
 
 def _bcast_from_last_bwd(axis_name, _res, ct):
-    P = lax.axis_size(axis_name)
+    P = compat.axis_size(axis_name)
     s = lax.axis_index(axis_name)
     return (jnp.where(s == P - 1, ct, jnp.zeros_like(ct)),)
 
@@ -91,7 +93,7 @@ def gpipe_apply(stage_fn: Callable, stage_params, microbatches: jnp.ndarray,
       ranks' rows are garbage and are masked by the caller via psum — see
       ``gpipe_loss``).
     """
-    P = lax.axis_size(axis_name)
+    P = compat.axis_size(axis_name)
     stage = lax.axis_index(axis_name)
     M = num_microbatches
     fn = jax.checkpoint(stage_fn, static_argnums=()) if remat else stage_fn
@@ -172,7 +174,7 @@ def one_f_one_b(stage_fn: Callable, loss_fn: Callable, stage_params,
     Returns each rank's OWN stage grads (sharded over ``axis_name``) and the
     replicated mean loss.
     """
-    P = lax.axis_size(axis_name)
+    P = compat.axis_size(axis_name)
     stage = lax.axis_index(axis_name)
     M = num_microbatches
     W = 2 * P - 1  # max microbatches in flight at stage 0, inclusive

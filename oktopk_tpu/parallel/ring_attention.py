@@ -20,6 +20,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from oktopk_tpu.comm import compat
+
 
 def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
                    axis_name: str, kv_mask: Optional[jnp.ndarray] = None,
@@ -34,7 +36,7 @@ def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
 
     Returns: [B, T_local, H, D] attention output for the local queries.
     """
-    P = lax.axis_size(axis_name)
+    P = compat.axis_size(axis_name)
     d = q.shape[-1]
     scale = scale if scale is not None else d ** -0.5
     q = q * scale

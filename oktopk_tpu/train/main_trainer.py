@@ -48,6 +48,18 @@ def parse_args(argv=None):
                         "collective each (reference <=640MiB bucketing, "
                         "VGG/allreducer.py:27); 1 = whole-model flat")
     p.add_argument("--compressor", default="oktopk")
+    p.add_argument("--autotune", action="store_true",
+                   help="pick each bucket's collective + density at "
+                        "runtime (autotune/: calibrated cost-model prior "
+                        "-> timed trial posterior); --compressor becomes "
+                        "the pre-plan fallback")
+    p.add_argument("--autotune-candidates", default="dense,oktopk",
+                   help="comma-separated registry names to trial")
+    p.add_argument("--autotune-trial-steps", type=int, default=3)
+    p.add_argument("--autotune-retune-every", type=int, default=0,
+                   help="steps between re-tunes (0 = tune once)")
+    p.add_argument("--autotune-journal", default=None,
+                   help="JSONL decision-journal path (see docs/PERF.md)")
     p.add_argument("--density", type=float, default=0.02)
     p.add_argument("--sigma-scale", type=float, default=2.5)
     p.add_argument("--grad-clip", type=float, default=None)
@@ -113,7 +125,13 @@ def main(argv=None):
         compute_dtype=args.compute_dtype,
         density=args.density, sigma_scale=args.sigma_scale,
         grad_clip=args.grad_clip, seed=args.seed,
-        num_workers=len(jax.devices()))
+        num_workers=len(jax.devices()),
+        autotune=args.autotune,
+        autotune_candidates=tuple(
+            s for s in args.autotune_candidates.split(",") if s),
+        autotune_trial_steps=args.autotune_trial_steps,
+        autotune_retune_every=args.autotune_retune_every,
+        autotune_journal=args.autotune_journal)
     slug = cfg.experiment_slug()
     # Observability and checkpoints are rank-0 work (the reference gates its
     # writer/checkpointer the same way, VGG/dl_trainer.py:614-616) — on a

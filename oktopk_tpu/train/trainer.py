@@ -120,19 +120,81 @@ class Trainer:
             params, self.model_state, self.optimizer, self.algo_cfg,
             momentum_correction=bool(self._mc_factor),
             num_buckets=cfg.num_buckets)
+        self.autotuner = None      # built lazily by autotune()
+        self._plans = None         # per-bucket BucketPlan list, or None
         self.step_fn = self._build_step()
         self._rng = jax.random.PRNGKey(cfg.seed + 1)
         self.metrics_history = []
 
     def _build_step(self):
+        compressor = self.cfg.compressor
+        densities = None
+        if self._plans:
+            compressor = [p.algo for p in self._plans]
+            densities = [p.density for p in self._plans]
         return build_sparse_grad_step(
             self._loss_fn, self.optimizer, self.algo_cfg, self.mesh,
-            compressor=self.cfg.compressor, axis_name=self.axis_name,
+            compressor=compressor, axis_name=self.axis_name,
             nsteps_update=self.cfg.nsteps_update,
             grad_clip=self.cfg.grad_clip, warmup=self._warmup,
             profile_norm=self._profile_norm,
             momentum_correction=self._mc_factor,
-            num_buckets=self.cfg.num_buckets)
+            num_buckets=self.cfg.num_buckets,
+            bucket_densities=densities)
+
+    # ---- autotuning ---------------------------------------------------
+
+    def _make_autotuner(self, fake_ms=None):
+        from oktopk_tpu.autotune import (Autotuner, AutotunePolicy,
+                                         DecisionJournal, TrialRunner)
+        from oktopk_tpu.autotune.policy import make_candidates
+        from oktopk_tpu.optim.distributed import (bucket_partition,
+                                                  bucket_sizes)
+
+        cfg = self.cfg
+        densities = tuple(cfg.autotune_densities) or (cfg.density,)
+        policy = AutotunePolicy(
+            candidates=make_candidates(cfg.autotune_candidates, densities),
+            hysteresis=cfg.autotune_hysteresis,
+            retune_every=cfg.autotune_retune_every,
+            max_trials=cfg.autotune_max_trials)
+        runner = TrialRunner(
+            mesh=self.mesh, axis_name=self.axis_name,
+            trial_steps=cfg.autotune_trial_steps, seed=cfg.seed,
+            base_cfg=self.algo_cfg, fake_ms=fake_ms)
+        sizes = bucket_sizes(self.state.params,
+                             bucket_partition(self.state.params,
+                                              cfg.num_buckets))
+        return Autotuner(
+            sizes, self.cfg.num_workers, policy, runner,
+            journal=DecisionJournal(cfg.autotune_journal))
+
+    def autotune(self, step: int = 0, fake_ms=None):
+        """Run (or re-run) the calibrate -> trial -> policy pass and adopt
+        the resulting per-bucket plan. The jitted step is rebuilt only
+        when the plan actually changed — the policy's hysteresis is what
+        keeps borderline buckets from forcing a recompile every re-tune.
+        Returns the plan list.
+
+        ``fake_ms(algo, n, density) -> ms`` injects synthetic trial
+        timings (CPU tests of the decision logic; see autotune/trial.py).
+        """
+        from oktopk_tpu.autotune import Autotuner
+
+        if self.autotuner is None:
+            self.autotuner = self._make_autotuner(fake_ms=fake_ms)
+        old = self._plans
+        self._plans = self.autotuner.tune(step=step, mesh=self.mesh)
+        if Autotuner.plans_changed(self._plans, old):
+            self.step_fn = self._build_step()
+        return self._plans
+
+    def maybe_autotune(self, step: int):
+        """Tune on first use and on the configured re-tune cadence."""
+        if not self.cfg.autotune:
+            return
+        if self.autotuner is None or self.autotuner.should_retune(step):
+            self.autotune(step=step)
 
     # ---- workload-specific pieces -------------------------------------
 
@@ -246,6 +308,10 @@ class Trainer:
                 break
             step = start_step + i + 1
             self.last_step = step
+            # plan (or re-plan) the per-bucket collectives before the step
+            # runs; a no-change verdict leaves step_fn (and its compiled
+            # program) untouched
+            self.maybe_autotune(step)
             if trace is not None:
                 trace.on_step(step)
             if timers is not None:
@@ -316,6 +382,10 @@ class Trainer:
             old[0], old[1], self.optimizer, self.algo_cfg,
             momentum_correction=bool(self._mc_factor), opt_state=old[2],
             num_buckets=self.cfg.num_buckets)
+        # trial measurements were taken on the old topology: drop the
+        # tuner (it re-tunes against the new mesh on the next cadence)
+        # but keep the current plan so the rebuilt step stays consistent
+        self.autotuner = None
         self.step_fn = self._build_step()
 
     # ---- eval ---------------------------------------------------------

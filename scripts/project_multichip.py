@@ -88,6 +88,12 @@ def load_bench_records():
                 recs.append((os.path.basename(p), r))
         except (ValueError, OSError):
             continue
+    recs = list(reversed(recs))
+    # the loose in-round capture ranks BELOW every driver-stamped
+    # BENCH_r*.json: the driver writes BENCH_r{N} from bench.py stdout at
+    # round end, strictly after any capture logged during the round — a
+    # stale capture (round 5: portable-path 387 ms vs the official
+    # kernel-path 178 ms) must not shadow the newer official record
     cap = os.path.join(REPO, "logs", "bench_capture.json")
     if os.path.exists(cap):
         try:
@@ -99,7 +105,6 @@ def load_bench_records():
                              json.loads(lines[-1])))
         except (ValueError, OSError):
             pass
-    recs = list(reversed(recs))
     # oldest fallback: the round-3 on-chip session measurements (PERF.md
     # prose, recorded machine-readably with provenance)
     chip = os.path.join(REPO, "logs", "chip_measurements.json")

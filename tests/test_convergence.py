@@ -32,6 +32,12 @@ def final_loss(compressor, mesh, steps=STEPS, seed=7):
 
 
 class TestConvergenceParity:
+    # slow: 2 x 80 mnistnet train steps on the emulated 8-device CPU mesh
+    # run multi-minute where CPU collectives are expensive (measured 405 s
+    # on the 0.4.x-jax container); the tier-1 'not slow' suite still pins
+    # convergence via tests/test_train.py's loss-decrease checks, and the
+    # committed curves live in logs/convergence/.
+    @pytest.mark.slow
     def test_oktopk_tracks_dense(self, mesh8):
         dense, dense_curve = final_loss("dense", mesh8)
         oktopk, oktopk_curve = final_loss("oktopk", mesh8)

@@ -9,6 +9,8 @@ from jax.sharding import PartitionSpec as P
 from oktopk_tpu.parallel.pipeline import gpipe_apply, gpipe_loss, one_f_one_b
 from oktopk_tpu.parallel.ring_attention import ring_attention
 
+from oktopk_tpu.comm import compat
+
 
 def full_attention(q, k, v, mask=None):
     scale = q.shape[-1] ** -0.5
@@ -33,7 +35,7 @@ class TestRingAttention:
         def f(q_, k_, v_):
             return ring_attention(q_[0], k_[0], v_[0], "data")[None]
 
-        out_sharded = jax.jit(jax.shard_map(
+        out_sharded = jax.jit(compat.shard_map(
             f, mesh=mesh4, in_specs=(P("data"),) * 3,
             out_specs=P("data")))(
             self._shard(q, 4), self._shard(k, 4), self._shard(v, 4))
@@ -55,7 +57,7 @@ class TestRingAttention:
                                   kv_mask=m_[0])[None]
 
         m_sh = jnp.moveaxis(mask.reshape(B, 4, 2), 1, 0)
-        out = jax.jit(jax.shard_map(
+        out = jax.jit(compat.shard_map(
             f, mesh=mesh4, in_specs=(P("data"),) * 4,
             out_specs=P("data")))(
             self._shard(q, 4), self._shard(k, 4), self._shard(v, 4), m_sh)
@@ -81,7 +83,7 @@ class TestGPipe:
             return gpipe_apply(stage_fn, w, x_, "data",
                                num_microbatches=M)
 
-        out = jax.jit(jax.shard_map(
+        out = jax.jit(compat.shard_map(
             f, mesh=mesh4, in_specs=(P("data"), P()), out_specs=P(),
             check_vma=False))(ws, x)
 
@@ -106,7 +108,7 @@ class TestGPipe:
             return gpipe_loss(stage_fn, sq, ws_[0], x_, y_, "data",
                               num_microbatches=M)
 
-        grad_fn = jax.jit(jax.shard_map(
+        grad_fn = jax.jit(compat.shard_map(
             jax.grad(loss), mesh=mesh4,
             in_specs=(P("data"), P(), P()), out_specs=P("data"),
             check_vma=False))
@@ -146,7 +148,7 @@ class TestGPipe:
             return gpipe_loss(stage_fn, sq, ws_[0], x_, y_, "data",
                               num_microbatches=M)
 
-        want_loss, want_g = jax.jit(jax.shard_map(
+        want_loss, want_g = jax.jit(compat.shard_map(
             jax.value_and_grad(loss), mesh=mesh4,
             in_specs=(P("data"), P(), P()),
             out_specs=(P(), P("data")), check_vma=False))(ws, x, y)
@@ -156,7 +158,7 @@ class TestGPipe:
                                num_microbatches=M)
             return l, g[None]
 
-        got_loss, got_g = jax.jit(jax.shard_map(
+        got_loss, got_g = jax.jit(compat.shard_map(
             f, mesh=mesh4, in_specs=(P("data"), P(), P()),
             out_specs=(P(), P("data")), check_vma=False))(ws, x, y)
         np.testing.assert_allclose(float(got_loss), float(want_loss),
@@ -176,7 +178,7 @@ class TestGPipe:
             def inner(ws_, x_):
                 return gpipe_apply(stage_fn, ws_[0], x_, "data",
                                    num_microbatches=M, remat=remat)
-            return jax.jit(jax.shard_map(
+            return jax.jit(compat.shard_map(
                 inner, mesh=mesh4, in_specs=(P("data"), P()), out_specs=P(),
                 check_vma=False))(ws, x)
 

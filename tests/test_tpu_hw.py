@@ -109,6 +109,64 @@ def test_pack_by_region_parity_on_chip(tpu_dev):
     np.testing.assert_array_equal(gv, wv)
 
 
+def test_select_repair_branch_parity_on_chip(tpu_dev):
+    """Mirror of tests/test_compaction.py::test_repair_branch_scattered_
+    overflow on silicon: a few scattered dense blocks put the dispatch in
+    the repair branch (0 < novf <= _novf_cap) — the one branch the round-5
+    hardware pass never executed (ADVICE r5): the repair kernel's
+    scalar-prefetched index_map + _materialize_het run under Mosaic, not
+    the interpreter."""
+    from oktopk_tpu.ops.compaction import BLK, CAPB_FAST, _novf_cap
+
+    rng = np.random.RandomState(11)
+    n = 64 * BLK
+    cap = 8 * BLK
+    x = rng.randn(n).astype(np.float32) * 0.1
+    for b in (3, 17, 40):
+        x[b * BLK:(b + 1) * BLK] = rng.randn(BLK) * 10 + 20
+    raw = (np.abs(x.reshape(-1, BLK)) >= 1.0).sum(axis=1)
+    excl = np.cumsum(raw) - raw
+    novf = int(((raw > CAPB_FAST) & (excl + CAPB_FAST < cap)).sum())
+    assert 0 < novf <= _novf_cap(64)
+    with jax.default_device(tpu_dev):
+        gv, gi, gc = select_by_threshold_pallas(jnp.asarray(x), 1.0, cap,
+                                                interpret=False)
+        gv, gi, gc = map(np.asarray, (gv, gi, gc))
+    wv, wi, wc = map(np.asarray,
+                     select_by_threshold(jnp.asarray(x), 1.0, cap))
+    assert gc == wc
+    np.testing.assert_array_equal(gi, wi)
+    np.testing.assert_array_equal(gv, wv)
+
+
+def test_pack_repair_branch_straddling_boundary_on_chip(tpu_dev):
+    """Mirror of tests/test_compaction.py::test_repair_branch_with_
+    straddling_boundary on silicon: one overflowed block contains a region
+    boundary past the fast-staged slots, so the straddle row must be read
+    from the repaired 1024-wide staging through the heterogeneous layout."""
+    from oktopk_tpu.ops.compaction import BLK, CAPB_FAST, _novf_cap
+
+    rng = np.random.RandomState(13)
+    n = 16 * BLK
+    x = rng.randn(n).astype(np.float32) * 0.1
+    x[5 * BLK:6 * BLK] = rng.randn(BLK) * 10 + 20
+    raw = (np.abs(x.reshape(-1, BLK)) >= 1.0).sum(axis=1)
+    assert 0 < int((raw > CAPB_FAST).sum()) <= _novf_cap(16)
+    bounds = np.asarray([0, 5 * BLK + 700, n], np.int32)
+    with jax.default_device(tpu_dev):
+        gv, gi, gc = pack_by_region_pallas(jnp.asarray(x), 1.0,
+                                           jnp.asarray(bounds), 2, 2 * BLK,
+                                           interpret=False)
+        gv, gi, gc = map(np.asarray, (gv, gi, gc))
+    wv, wi, wc = map(np.asarray,
+                     pack_by_region(jnp.asarray(x),
+                                    jnp.abs(jnp.asarray(x)) >= 1.0,
+                                    jnp.asarray(bounds), 2, 2 * BLK))
+    np.testing.assert_array_equal(gc, wc)
+    np.testing.assert_array_equal(gi, wi)
+    np.testing.assert_array_equal(gv, wv)
+
+
 def test_mesh_supports_pallas_on_hw(tpu_dev):
     from oktopk_tpu.comm.mesh import get_mesh
     mesh = get_mesh((1,), ("data",), devices=[tpu_dev])

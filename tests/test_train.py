@@ -158,7 +158,12 @@ class TestEval:
         it = synthetic_iterator("lstman4_tiny", 4, seed=6, seq_len=101)
         m = tr.eval_step(next(it))
         assert np.isfinite(float(m["loss"])) and float(m["loss"]) > 0
-        assert 0.0 <= float(m["cer"]) <= float(m["wer"]) + 1e-6
+        # CER can legitimately exceed WER (an untrained model's garbage
+        # transcript costs more char edits than ref chars while the word
+        # distance saturates near 1), so no cer <= wer ordering is
+        # asserted — only that both are real, bounded metrics
+        assert 0.0 <= float(m["cer"]) <= 3.0
+        assert 0.0 <= float(m["wer"]) <= 3.0
         # an untrained model cannot beat chance on tone-coded utterances
         assert float(m["wer"]) > 0.5
 
