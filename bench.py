@@ -294,6 +294,27 @@ def step_time_probe(iters=10):
         print(f"[bench] oktopk_autotuned probe failed: {e!r}",
               file=sys.stderr)
 
+    # numeric-health tail (resilience/): a few guarded oktopk steps so the
+    # bench driver tracks numeric health alongside latency — steps_skipped
+    # and fallback_events must be 0 on a healthy chip, and grad_nonfinite
+    # flags the blow-up step when they are not. Last in the priority
+    # order: a deadline kill here costs no timing.
+    try:
+        cfg = TrainConfig(dnn="vgg16", dataset="cifar10", batch_size=16,
+                          lr=0.1, compressor="oktopk", density=0.02,
+                          num_workers=1, resilience=True)
+        trainer = Trainer(cfg, mesh=mesh, warmup=False)
+        for step in range(1, 3):
+            m = trainer.train_step(batches[16])
+            trainer.supervise(step, m)
+        import numpy as _np
+        out["grad_nonfinite"] = int(_np.asarray(m["grad_nonfinite"]))
+        out["steps_skipped"] = int(_np.asarray(m["steps_skipped"]))
+        out["fallback_events"] = trainer.supervisor.fallback_events
+        print("STEP_PROBE " + json.dumps(out), flush=True)
+    except Exception as e:
+        print(f"[bench] resilience probe failed: {e!r}", file=sys.stderr)
+
     print(f"[bench] {out}", file=sys.stderr)
     return out
 
@@ -355,7 +376,8 @@ def main():
                     "flops_per_step_bs256_scaled", "peak_flops_assumed",
                     "peak_flops_bf16_assumed",
                     "mfu_dense", "mfu_oktopk", "mfu_dense_bs256",
-                    "mfu_oktopk_bs256", "mfu_dense_bf16_bs256"):
+                    "mfu_oktopk_bs256", "mfu_dense_bf16_bs256",
+                    "grad_nonfinite", "steps_skipped", "fallback_events"):
             if key in steps:
                 rec[key] = (round(steps[key], 3)
                             if isinstance(steps[key], float)

@@ -7,7 +7,13 @@ JSON on purpose: it survives crashes mid-run (every line that made it to
 disk parses alone) and greps cleanly, like the reference's per-rank
 profiling logs (VGG/allreducer.py:702-703) but machine-readable.
 
-Schema (all events carry ``event`` and ``step``):
+Schema — the first record is always an environment header, so decision
+logs are comparable across containers/relays (the same tuner on jax
+0.4.x/CPU vs 0.9/TPU legitimately decides differently); subsequent
+events carry ``event`` and ``step``:
+
+  {"event": "header", "jax": "0.4.37", "jaxlib": "0.4.36",
+   "device_kind": "cpu", "platform": "cpu", "world_size": 8}
 
   {"event": "calibration", "step": 0, "num_workers": 8,
    "alpha": 1.1e-6, "beta": 9.8e-12, "sizes": [...], "times_ms": [...],
@@ -33,11 +39,35 @@ import os
 from typing import Any, Dict, List, Optional
 
 
+def environment_header() -> Dict[str, Any]:
+    """The jax/jaxlib/device/world identification every journal leads
+    with. Tolerant of an uninitialisable backend (the header must never
+    be the reason a journal cannot be written)."""
+    import jax
+
+    hdr: Dict[str, Any] = {"jax": jax.__version__}
+    try:
+        import jaxlib
+        hdr["jaxlib"] = getattr(jaxlib, "__version__", None)
+    except Exception:
+        hdr["jaxlib"] = None
+    try:
+        devs = jax.devices()
+        hdr["device_kind"] = getattr(devs[0], "device_kind",
+                                     devs[0].platform)
+        hdr["platform"] = devs[0].platform
+        hdr["world_size"] = len(devs)
+    except Exception:
+        hdr.update(device_kind=None, platform=None, world_size=0)
+    return hdr
+
+
 class DecisionJournal:
     """Append-only JSONL writer. ``path=None`` keeps entries in memory only
-    (tests, or callers that just want the plan)."""
+    (tests, or callers that just want the plan). ``header=True`` writes
+    the :func:`environment_header` as the first record."""
 
-    def __init__(self, path: Optional[str] = None):
+    def __init__(self, path: Optional[str] = None, header: bool = True):
         self.path = path
         self.entries: List[Dict[str, Any]] = []
         if path:
@@ -46,6 +76,8 @@ class DecisionJournal:
             # truncate: one journal per tuner lifetime; re-tunes append
             with open(path, "w"):
                 pass
+        if header:
+            self.record("header", **environment_header())
 
     def record(self, event: str, **fields) -> Dict[str, Any]:
         entry = {"event": event, **fields}

@@ -36,7 +36,7 @@ def gaussian_k(grad: jnp.ndarray, state: SparseState, cfg: OkTopkConfig,
     packed_mask = jnp.zeros((n,), bool).at[idx].set(True, mode="drop")
     residual = residual_after_selection(acc, packed_mask, cfg)
 
-    gv = all_gather(on_wire(vals, cfg), axis_name).astype(acc.dtype)
+    gv = all_gather(on_wire(vals, cfg, state.step), axis_name).astype(acc.dtype)
     gi = all_gather(idx, axis_name)
     result = scatter_sparse(n, gv, gi) / P
 

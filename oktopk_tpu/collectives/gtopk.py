@@ -52,7 +52,7 @@ def gtopk(grad: jnp.ndarray, state: SparseState, cfg: OkTopkConfig,
         # round's loss is captured by the selection residual above;
         # later rounds re-round merged sums (collectives/wire.py).
         vals = wire_round(vals, cfg)
-        pv = ppermute_pair(on_wire(vals, cfg), axis_name, d) \
+        pv = ppermute_pair(on_wire(vals, cfg, state.step), axis_name, d) \
             .astype(acc.dtype)            # lossless: vals already rounded
         pi = ppermute_pair(idx, axis_name, d)
         merged = scatter_sparse(n, jnp.concatenate([vals, pv]),

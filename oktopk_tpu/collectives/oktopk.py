@@ -197,7 +197,7 @@ def oktopk(grad: jnp.ndarray, state: SparseState, cfg: OkTopkConfig,
     local_count = jnp.sum(mask)
     s_vals, s_idx, s_counts = pack_by_region(
         acc, mask, boundaries, P, cfg.cap_pair, thresh=lt, use_pallas=up)
-    r_vals = all_to_all(_on_wire(s_vals, cfg), axis_name) \
+    r_vals = all_to_all(_on_wire(s_vals, cfg, state.step), axis_name) \
         .astype(acc.dtype)                     # [P, cap_pair]
     r_idx = all_to_all(s_idx, axis_name)
     reduced = scatter_sparse(n, r_vals, r_idx)  # nonzero only in own region
@@ -239,7 +239,7 @@ def oktopk(grad: jnp.ndarray, state: SparseState, cfg: OkTopkConfig,
         else:
             cand_mask = (jnp.abs(reduced) >= t_cand) & (reduced != 0.0)
             vals, idx, cand_count = select_mask(reduced, cand_mask, k_cand)
-        gv = all_gather(_on_wire(vals, cfg), axis_name) \
+        gv = all_gather(_on_wire(vals, cfg, state.step), axis_name) \
             .astype(acc.dtype)                         # [P, k_cand]
         gi = all_gather(idx, axis_name)
         # Python min when k is static (the "sort" method needs it so);
@@ -269,7 +269,7 @@ def oktopk(grad: jnp.ndarray, state: SparseState, cfg: OkTopkConfig,
         gt_use = state.global_threshold * drift
         gvals, gidx, gcount = select_by_threshold(reduced, gt_use, cap_g,
                                                   use_pallas=up)
-        gv = all_gather(_on_wire(gvals, cfg), axis_name) \
+        gv = all_gather(_on_wire(gvals, cfg, state.step), axis_name) \
             .astype(acc.dtype)                         # [P, cap_g]
         gi = all_gather(gidx, axis_name)
         result = scatter_sparse(n, gv, gi)

@@ -48,7 +48,7 @@ def topk_a(grad: jnp.ndarray, state: SparseState, cfg: OkTopkConfig,
     sel_mask = jnp.zeros((n,), bool).at[idx].set(True)
     residual = residual_after_selection(acc, sel_mask, cfg)
 
-    gv = all_gather(on_wire(vals, cfg), axis_name).astype(acc.dtype)  # [P, k]
+    gv = all_gather(on_wire(vals, cfg, state.step), axis_name).astype(acc.dtype)  # [P, k]
     gi = all_gather(idx, axis_name)           # [P, k]
     result = scatter_sparse(n, gv, gi) / P
 
@@ -91,7 +91,7 @@ def topk_a_opt(grad: jnp.ndarray, state: SparseState, cfg: OkTopkConfig,
     packed_mask = jnp.zeros((n,), bool).at[idx].set(True, mode="drop")
     residual = residual_after_selection(acc, packed_mask, cfg)
 
-    gv = all_gather(on_wire(vals, cfg), axis_name).astype(acc.dtype)
+    gv = all_gather(on_wire(vals, cfg, state.step), axis_name).astype(acc.dtype)
     gi = all_gather(idx, axis_name)
     result = scatter_sparse(n, gv, gi) / P
 

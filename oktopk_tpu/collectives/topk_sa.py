@@ -47,7 +47,7 @@ def _split_allreduce(acc, lt, state: SparseState, cfg: OkTopkConfig,
     s_vals, s_idx, s_counts = pack_by_region(
         acc, mask, boundaries, P, cfg.cap_pair, thresh=lt,
         use_pallas=bool(cfg.use_pallas))
-    r_vals = all_to_all(on_wire(s_vals, cfg), axis_name).astype(acc.dtype)
+    r_vals = all_to_all(on_wire(s_vals, cfg, state.step), axis_name).astype(acc.dtype)
     r_idx = all_to_all(s_idx, axis_name)
     reduced = scatter_sparse(n, r_vals, r_idx)
 
@@ -64,7 +64,7 @@ def _split_allreduce(acc, lt, state: SparseState, cfg: OkTopkConfig,
     def sparse_gather():
         gvals, gidx, gcount = select_nonzero(
             reduced, cap_g, use_pallas=bool(cfg.use_pallas))
-        gv = all_gather(on_wire(gvals, cfg), axis_name).astype(acc.dtype)
+        gv = all_gather(on_wire(gvals, cfg, state.step), axis_name).astype(acc.dtype)
         gi = all_gather(gidx, axis_name)
         result = scatter_sparse(n, gv, gi)
         total = psum(gcount, axis_name)

@@ -27,6 +27,8 @@ the all-ranks-identical-result invariant survives.
 
 from __future__ import annotations
 
+from typing import Callable, Optional
+
 import jax.numpy as jnp
 
 from oktopk_tpu.config import OkTopkConfig
@@ -35,12 +37,32 @@ from oktopk_tpu.ops.residual import (
     update_residual_at_winners,
 )
 
+# Fault-injection seam (resilience/faults.py): a trace-time transform
+# applied to every value buffer as it crosses a collective. Installed
+# before building a step, the corruption is baked into that jitted
+# program; the default (None) traces nothing extra at all. The hook
+# receives ``(buffer, cfg, step)`` with ``step`` the bucket's allreduce
+# counter (a traced i32 scalar) — algorithms pass it so a FaultPlan can
+# target one step deterministically.
+_WIRE_FAULT: Optional[Callable] = None
 
-def on_wire(x, cfg: OkTopkConfig):
+
+def install_wire_fault(hook: Optional[Callable]) -> Optional[Callable]:
+    """Install (or, with None, clear) the wire fault hook; returns the
+    previous hook so chaos tests can restore it."""
+    global _WIRE_FAULT
+    prev = _WIRE_FAULT
+    _WIRE_FAULT = hook
+    return prev
+
+
+def on_wire(x, cfg: OkTopkConfig, step=None):
     """The value buffer as it actually crosses the collective."""
-    if cfg.wire_dtype == "float32":
-        return x
-    return x.astype(jnp.bfloat16)
+    if cfg.wire_dtype != "float32":
+        x = x.astype(jnp.bfloat16)
+    if _WIRE_FAULT is not None:
+        x = _WIRE_FAULT(x, cfg, step)
+    return x
 
 
 def wire_round(x, cfg: OkTopkConfig):

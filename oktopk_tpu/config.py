@@ -140,6 +140,14 @@ class OkTopkConfig:
     # (collectives/api.py, optim/distributed.py).
     use_pallas: Optional[bool] = None
 
+    # Which reverse-layer-order gradient bucket this config instance
+    # serves. Set by the multi-bucket step builder (optim/distributed.py)
+    # so trace-time seams that only see the config — e.g. the wire
+    # fault-injection hook (collectives/wire.py, resilience/faults.py) —
+    # can target a single bucket. Purely informational for the
+    # algorithms themselves.
+    bucket_index: int = 0
+
     # Wire dtype for sparse message VALUES (indices stay int32). "bfloat16"
     # halves the value bytes of every exchange — the TPU-native analogue of
     # the reference's custom float16 MPI datatype + sum op
@@ -329,6 +337,32 @@ class TrainConfig:
     autotune_max_trials: int = 0
     # JSONL decision-journal path; None keeps the journal in memory.
     autotune_journal: Optional[str] = None
+
+    # ---- numeric-health guard + escalation (resilience/) --------------
+    # When True the distributed step carries the in-step anomaly guard:
+    # nonfinite local gradients or nonfinite/absurd post-collective
+    # values trip a psum-agreed skip — the optimizer update AND the
+    # compressor residual/threshold updates roll back for that step (no
+    # error-feedback poisoning) — and the trainer runs the host-side
+    # supervisor (strike counters -> per-bucket dense fallback ->
+    # checkpoint restore on divergence).
+    resilience: bool = False
+    # Reduced-gradient magnitude ceiling: finite-but-absurd values (wire
+    # bit-flips land near 1e38) count as anomalies above it.
+    resilience_abs_limit: float = 1e18
+    # Guard trips on a bucket before the supervisor flips it to dense.
+    resilience_strikes: int = 3
+    # Consecutive skipped steps before a restore from the last good
+    # checkpoint is attempted.
+    resilience_divergence_limit: int = 8
+    # Steps the supervisor waits after an escalation before escalating
+    # again (retry/backoff: one fault burst must not cascade).
+    resilience_cooldown: int = 4
+    # Supervisor poll cadence in steps. Each check fetches the guard
+    # metrics to host (a device sync); 1 = react within a step.
+    resilience_check_every: int = 1
+    # JSONL health-journal path; None keeps the journal in memory.
+    resilience_journal: Optional[str] = None
 
     def experiment_slug(self) -> str:
         """Reference experiment naming convention

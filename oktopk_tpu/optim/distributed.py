@@ -37,12 +37,16 @@ from oktopk_tpu.config import OkTopkConfig
 class DistTrainState:
     """Replicated training state + per-worker sparse state (leading device
     axis on every SparseState leaf). ``local_momentum`` is the per-worker
-    flat momentum buffer used only under momentum correction."""
+    flat momentum buffer used only under momentum correction.
+    ``health`` is the replicated :class:`resilience.guard.HealthState`
+    (attempt/skip counters), present only when the step carries the
+    anomaly guard or a fault plan."""
     params: Any
     model_state: Any          # e.g. flax batch_stats collection
     opt_state: Any
     sparse_state: SparseState
     local_momentum: Any = None
+    health: Any = None
 
 
 def flat_size(params) -> int:
@@ -93,13 +97,16 @@ def init_dist_state(params, model_state, optimizer, cfg: OkTopkConfig,
                     dtype=jnp.float32,
                     momentum_correction: bool = False,
                     opt_state: Any = None,
-                    num_buckets: int = 1) -> DistTrainState:
+                    num_buckets: int = 1,
+                    with_health: bool = False) -> DistTrainState:
     """``momentum_correction`` must be truthy iff the step builder gets a
     nonzero ``momentum_correction`` factor — the shard_map specs key off the
     presence of ``local_momentum``. Pass ``opt_state`` to carry over existing
     optimizer state (e.g. across an elastic resize) instead of allocating a
     fresh one. With ``num_buckets > 1`` the sparse state (and momentum) is a
-    tuple of per-bucket states matching :func:`bucket_partition`."""
+    tuple of per-bucket states matching :func:`bucket_partition`.
+    ``with_health`` must be truthy iff the step builder gets a guard or a
+    fault plan — the shard_map specs key off the presence of ``health``."""
     def batched(n_b):
         s = init_state(cfg.replace(n=n_b), dtype)
         return jax.tree.map(
@@ -114,10 +121,15 @@ def init_dist_state(params, model_state, optimizer, cfg: OkTopkConfig,
         s = batched(cfg.n)
         mom = (jnp.zeros((cfg.num_workers, cfg.n), dtype)
                if momentum_correction else None)
+    health = None
+    if with_health:
+        from oktopk_tpu.resilience.guard import init_health
+        health = init_health(num_buckets)
     return DistTrainState(params=params, model_state=model_state,
                           opt_state=(optimizer.init(params)
                                      if opt_state is None else opt_state),
-                          sparse_state=s, local_momentum=mom)
+                          sparse_state=s, local_momentum=mom,
+                          health=health)
 
 
 def build_sparse_grad_step(
@@ -134,6 +146,8 @@ def build_sparse_grad_step(
     momentum_correction: float = 0.0,
     num_buckets: int = 1,
     bucket_densities: Optional[Sequence[float]] = None,
+    guard=None,
+    fault_plan=None,
 ):
     """Build the jitted distributed train step.
 
@@ -167,6 +181,18 @@ def build_sparse_grad_step(
         program; changing the plan means rebuilding the step.
       bucket_densities: optional per-bucket density overrides, parallel to
         the compressor sequence (the autotuner's chosen densities).
+      guard: optional ``resilience.guard.GuardConfig`` — adds the in-step
+        anomaly guard: per-bucket nonfinite/absurd-value counts are
+        psum-agreed across replicas, and on any trip the optimizer update
+        AND every bucket's compressor residual/threshold update roll back
+        (bit-identical training state; only step counters and volume
+        accounting advance). Emits ``step_skipped``/``steps_skipped``/
+        ``bucket_anomalies`` metrics. Requires ``state.health``
+        (``init_dist_state(with_health=True)``).
+      fault_plan: optional ``resilience.faults.FaultPlan`` — bakes the
+        plan's deterministic NaN/Inf gradient injection into the traced
+        step (wire-payload faults install separately via
+        ``collectives.wire.install_wire_fault``). Chaos drills only.
 
     Returns ``step(state: DistTrainState, batch, rng) -> (state, metrics)``.
     ``batch`` leaves are [num_workers * nsteps_update * mb, ...] and get
@@ -185,8 +211,16 @@ def build_sparse_grad_step(
             f"bucket_densities has {len(bucket_densities)} entries for "
             f"{nb} buckets")
     algos = [get_algorithm(nm, warmup=warmup) for nm in names]
+    has_health = guard is not None or fault_plan is not None
+    if has_health:
+        from oktopk_tpu.resilience import faults as _faults  # noqa: F401
+        from oktopk_tpu.resilience import guard as _guard_mod
 
     def shard_fn(state: DistTrainState, batch, rng):
+        if has_health and state.health is None:
+            raise ValueError(
+                "guard/fault_plan need state.health: build the state with "
+                "init_dist_state(with_health=True)")
         rng = jax.random.fold_in(rng, lax.axis_index(axis_name))
 
         # --- local grads, with optional microbatch accumulation ---
@@ -234,7 +268,7 @@ def build_sparse_grad_step(
                     else list(state.local_momentum))
                    if momentum_correction else None)
         results = [None] * len(leaves)
-        new_sparse, new_moms = [], []
+        sp_olds, sp_news, new_moms, bad_counts = [], [], [], []
         vol = lk = gk = jnp.asarray(0.0, jnp.float32)
         eps_num = eps_den = jnp.asarray(0.0, jnp.float32)
         for bi, idxs in enumerate(buckets):
@@ -242,29 +276,41 @@ def build_sparse_grad_step(
             over = {}
             if not single:
                 over["n"] = int(flat.size)
+                over["bucket_index"] = bi
             if bucket_densities is not None:
                 over["density"] = float(bucket_densities[bi])
             cfg_b = cfg.replace(**over) if over else cfg
             sp = jax.tree.map(lambda x: x[0], states_in[bi])
+            if fault_plan is not None:
+                # chaos drill: deterministic NaN/Inf poisoning of this
+                # bucket's local gradient, indexed by the monotonic
+                # attempted-step counter (a guard skip must not freeze a
+                # one-step fault into a permanent one)
+                flat = _faults.inject_grad_faults(
+                    fault_plan, flat, state.health.step,
+                    lax.axis_index(axis_name), bi)
             if momentum_correction:
                 flat = momentum_correction * moms_in[bi][0] + flat
                 new_moms.append(flat[None])
-            reduced, sp = algos[bi](flat, sp, cfg_b, axis_name)
+            reduced, sp_new = algos[bi](flat, sp, cfg_b, axis_name)
+            if guard is not None:
+                bad_counts.append(
+                    _guard_mod.local_anomaly_count(flat, reduced, guard))
             off = 0
             for i in idxs:
                 sz = leaves[i].size
                 results[i] = reduced[off:off + sz].reshape(leaves[i].shape)
                 off += sz
-            new_sparse.append(jax.tree.map(lambda x: x[None], sp))
-            vol = vol + sp.last_volume
-            lk = lk + sp.last_local_count
-            gk = gk + sp.last_global_count
+            sp_olds.append(sp)
+            sp_news.append(sp_new)
+            vol = vol + sp_new.last_volume
+            lk = lk + sp_new.last_local_count
+            gk = gk + sp_new.last_global_count
             if profile_norm:
                 dense = lax.pmean(flat, axis_name)
                 eps_num = eps_num + jnp.sum((dense - reduced) ** 2)
                 eps_den = eps_den + jnp.sum(dense ** 2)
         grads = jax.tree.unflatten(treedef, results)
-        sparse_out = new_sparse[0] if single else tuple(new_sparse)
         if momentum_correction:
             new_momentum = new_moms[0] if single else tuple(new_moms)
         else:
@@ -292,16 +338,59 @@ def build_sparse_grad_step(
         }
         if eps is not None:
             metrics["eps_vs_dense"] = eps
+
+        # --- in-step anomaly guard (resilience/guard.py): agree on a
+        # global skip flag, then make the whole step a training no-op —
+        # optimizer update discarded, compressor residual/threshold
+        # updates rolled back bucket-by-bucket so error feedback is never
+        # poisoned. Step counters and wire-volume accounting still
+        # advance (the skipped step consumed its batch and its wire). ---
+        health = state.health
+        if guard is not None:
+            flags, any_bad = _guard_mod.agree(bad_counts, axis_name)
+            params = _guard_mod.guarded(any_bad, state.params, params)
+            opt_state = _guard_mod.guarded(any_bad, state.opt_state,
+                                           opt_state)
+            model_state = _guard_mod.guarded(any_bad, state.model_state,
+                                             model_state)
+            if momentum_correction:
+                new_momentum = _guard_mod.guarded(
+                    any_bad, state.local_momentum, new_momentum)
+            sp_news = [
+                _guard_mod.guarded(
+                    any_bad,
+                    old.replace(step=new.step,
+                                volume_elems=new.volume_elems,
+                                last_volume=new.last_volume,
+                                last_local_count=new.last_local_count,
+                                last_global_count=new.last_global_count),
+                    new)
+                for old, new in zip(sp_olds, sp_news)]
+            health = _guard_mod.advance(health, any_bad, flags)
+            metrics["step_skipped"] = any_bad.astype(jnp.int32)
+            metrics["steps_skipped"] = health.steps_skipped
+            metrics["bucket_anomalies"] = (flags > 0).astype(jnp.int32)
+        elif has_health:
+            # fault plan without a guard: the attempt counter still has
+            # to advance or a one-step fault would re-inject forever
+            health = _guard_mod.advance(
+                health, jnp.asarray(False),
+                jnp.zeros_like(health.bucket_trips))
+
+        new_sparse = [jax.tree.map(lambda x: x[None], s) for s in sp_news]
+        sparse_out = new_sparse[0] if single else tuple(new_sparse)
         new_state = DistTrainState(
             params=params, model_state=model_state, opt_state=opt_state,
             sparse_state=sparse_out,
-            local_momentum=new_momentum)
+            local_momentum=new_momentum,
+            health=health)
         return new_state, metrics
 
     state_specs = DistTrainState(
         params=P(), model_state=P(), opt_state=P(),
         sparse_state=P(axis_name),
-        local_momentum=P(axis_name) if momentum_correction else None)
+        local_momentum=P(axis_name) if momentum_correction else None,
+        health=P() if has_health else None)
     mapped = compat.shard_map(
         shard_fn, mesh=mesh,
         in_specs=(state_specs, P(axis_name), P()),

@@ -1,0 +1,48 @@
+"""Fault injection, in-step anomaly guard, and supervised dense-fallback.
+
+Ok-Topk's error-feedback residuals make sparse training *stateful*: one
+NaN/Inf gradient or corrupted wire payload poisons every subsequent step
+through the residual, and the reference only ever *warns* on NaN gradient
+sparsity (VGG/dl_trainer.py:608-609). The gradient-compression systems
+literature (PAPERS.md: "On the Utility of Gradient Compression...",
+arXiv 2103.00543; SparCML, arXiv 1802.08021) shows sparse pipelines are
+exactly where silent numeric corruption and degraded-fabric behaviour
+diverge from dense. This package closes the loop in three layers:
+
+1. `faults`     — deterministic, step-indexed :class:`FaultPlan` with
+   injection seams for NaN/Inf gradients, corrupted sparse wire payloads
+   (bit-flip / zeroed values at the ``collectives/wire.py`` seam) and
+   per-step collective latency inflation. Pure/config-driven so the CPU
+   tier-1 suite exercises every path.
+2. `guard`      — a jitted in-step anomaly guard: psum a finite-agreement
+   flag so all replicas deterministically agree, then skip the optimizer
+   update AND roll back the compressor residual/threshold update for the
+   step (no error-feedback poisoning), emitting ``steps_skipped``.
+3. `supervisor` — host-side escalation: consecutive-anomaly and
+   per-bucket strike counters; after N strikes on a bucket its plan flips
+   to ``dense`` (reusing the autotune plan-rebuild machinery in
+   ``Trainer``); unrecoverable divergence restores from the last good
+   checkpoint via ``train/checkpoint.py``.
+4. `journal`    — JSONL health log (same shape as ``autotune/journal.py``):
+   every fault seen, guard trip, fallback and restore, with step index
+   and bucket id.
+"""
+
+from oktopk_tpu.resilience.faults import (  # noqa: F401
+    FaultPlan,
+    FaultSpec,
+    inject_grad_faults,
+    latency_ms,
+    make_wire_hook,
+    with_latency,
+)
+from oktopk_tpu.resilience.guard import (  # noqa: F401
+    GuardConfig,
+    HealthState,
+    init_health,
+)
+from oktopk_tpu.resilience.journal import HealthJournal  # noqa: F401
+from oktopk_tpu.resilience.supervisor import (  # noqa: F401
+    Action,
+    Supervisor,
+)

@@ -1,0 +1,223 @@
+"""Deterministic, step-indexed fault injection.
+
+A :class:`FaultPlan` is a frozen (hashable) tuple of :class:`FaultSpec`
+entries, so it can be closed over by jitted programs exactly like
+``OkTopkConfig``. Every injection seam is a pure function of
+``(plan, step, rank, bucket)``: the same plan replayed against the same
+training run produces the same corruption, which is what makes the
+emulated-mesh chaos tests deterministic (and what distinguishes a fault
+*drill* from real corruption — the guard/supervisor must not be able to
+tell the difference).
+
+Three fault families, mirroring what degrades in real sparse pipelines:
+
+- ``nan_grad`` / ``inf_grad``: the local gradient blows up on one (or
+  every) worker — the failure the reference merely warns about
+  (VGG/dl_trainer.py:608-609). Injected on the flat per-bucket gradient
+  inside ``optim.distributed.build_sparse_grad_step``, *before* the
+  residual accumulation, so an unguarded run demonstrably poisons its
+  error feedback.
+- ``wire_bitflip`` / ``wire_zero``: the sparse message payload is
+  corrupted in transit. Injected at the ``collectives/wire.py`` seam
+  (:func:`make_wire_hook`), i.e. on the value buffer exactly as it
+  crosses the collective, on the chosen sender shard only. A bit-flip
+  XORs the top exponent bit (huge-magnitude values, the classic silent
+  fabric corruption); zeroing models dropped payloads — note that zeroed
+  winners are *recovered* by error feedback (senders keep the mass in
+  their residual), which the chaos tests assert.
+- ``latency``: per-step collective latency inflation on the emulated
+  mesh (:func:`latency_ms` / :func:`with_latency`) — degraded-fabric
+  behaviour for the supervisor/autotuner timing paths, host-side so CPU
+  tests can exercise it without a slow wire.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Callable, Optional, Tuple
+
+import jax.numpy as jnp
+from jax import lax
+
+FAULT_KINDS = ("nan_grad", "inf_grad", "wire_bitflip", "wire_zero",
+               "latency")
+
+
+@dataclasses.dataclass(frozen=True)
+class FaultSpec:
+    """One fault: ``kind`` active on attempted-step indices
+    ``[step, step + duration)``.
+
+    ``worker``/``bucket`` select a single shard / gradient bucket (-1 =
+    all). ``count`` bounds the corruption to the leading elements of the
+    target buffer (-1 = the whole buffer). ``latency_ms`` applies to
+    ``kind == "latency"`` only; ``bit_mask`` overrides the XOR pattern of
+    ``wire_bitflip`` (0 = flip the top exponent bit of the wire dtype).
+    """
+
+    kind: str
+    step: int
+    duration: int = 1
+    worker: int = -1
+    bucket: int = -1
+    count: int = -1
+    latency_ms: float = 0.0
+    bit_mask: int = 0
+
+    def __post_init__(self):
+        if self.kind not in FAULT_KINDS:
+            raise ValueError(
+                f"unknown fault kind {self.kind!r}; one of {FAULT_KINDS}")
+        if self.duration < 1:
+            raise ValueError(f"duration must be >= 1, got {self.duration}")
+        if self.step < 0:
+            raise ValueError(f"step must be >= 0, got {self.step}")
+
+
+@dataclasses.dataclass(frozen=True)
+class FaultPlan:
+    """A deterministic schedule of faults (hashable; closed over by jit)."""
+
+    faults: Tuple[FaultSpec, ...] = ()
+
+    def __post_init__(self):
+        # accept any iterable of specs but store a hashable tuple
+        object.__setattr__(self, "faults", tuple(self.faults))
+
+    def of_kind(self, *kinds: str) -> Tuple[FaultSpec, ...]:
+        return tuple(f for f in self.faults if f.kind in kinds)
+
+    @property
+    def grad_faults(self) -> Tuple[FaultSpec, ...]:
+        return self.of_kind("nan_grad", "inf_grad")
+
+    @property
+    def wire_faults(self) -> Tuple[FaultSpec, ...]:
+        return self.of_kind("wire_bitflip", "wire_zero")
+
+    @property
+    def latency_faults(self) -> Tuple[FaultSpec, ...]:
+        return self.of_kind("latency")
+
+
+def _active(spec: FaultSpec, step, rank):
+    """Traced 0/1 activity flag of ``spec`` at (step, rank)."""
+    act = (step >= spec.step) & (step < spec.step + spec.duration)
+    if spec.worker >= 0:
+        act = act & (rank == spec.worker)
+    return act
+
+
+def _leading_mask(n: int, count: int):
+    """Boolean [n] mask of the corrupted prefix (count < 0 = all)."""
+    if count < 0 or count >= n:
+        return jnp.ones((n,), bool)
+    return jnp.arange(n) < count
+
+
+def inject_grad_faults(plan: FaultPlan, flat: jnp.ndarray, step, rank,
+                       bucket: int) -> jnp.ndarray:
+    """Poison the local flat gradient of ``bucket`` per the plan.
+
+    ``step``/``rank`` are traced scalars (the monotonic attempted-step
+    counter and ``lax.axis_index``); ``bucket`` is the static bucket
+    index, so inactive buckets trace no extra ops at all.
+    """
+    for f in plan.grad_faults:
+        if f.bucket >= 0 and f.bucket != bucket:
+            continue
+        bad = jnp.inf if f.kind == "inf_grad" else jnp.nan
+        where = _leading_mask(flat.size, f.count)
+        poisoned = jnp.where(where, jnp.asarray(bad, flat.dtype), flat)
+        flat = jnp.where(_active(f, step, rank), poisoned, flat)
+    return flat
+
+
+def _bitflip(x: jnp.ndarray, mask: int) -> jnp.ndarray:
+    """XOR the float bits of ``x`` (0 = flip the top exponent bit)."""
+    if x.dtype == jnp.bfloat16:
+        u, default = jnp.uint16, 1 << 14
+    elif x.dtype == jnp.float32:
+        u, default = jnp.uint32, 1 << 30
+    else:  # float64 CPU paths
+        u, default = jnp.uint64, 1 << 62
+    m = jnp.asarray(mask or default, u)
+    return lax.bitcast_convert_type(
+        lax.bitcast_convert_type(x, u) ^ m, x.dtype)
+
+
+def make_wire_hook(plan: FaultPlan, axis_name: str = "data"
+                   ) -> Callable[[jnp.ndarray, object, object], jnp.ndarray]:
+    """Build the trace-time hook ``collectives/wire.py`` applies to every
+    value buffer as it crosses a collective (install with
+    ``wire.install_wire_fault``).
+
+    The hook corrupts the payload on the chosen SENDER shard only —
+    equivalent to fabric corruption of that shard's outgoing messages —
+    and targets one bucket via ``cfg.bucket_index`` (set by the
+    multi-bucket step builder). ``step`` is the bucket's allreduce
+    counter; call sites that cannot supply one (step=None) are left
+    untouched rather than corrupted unconditionally.
+    """
+
+    def hook(x, cfg, step):
+        if step is None or not plan.wire_faults:
+            return x
+        rank = lax.axis_index(axis_name)
+        for f in plan.wire_faults:
+            if f.bucket >= 0 and f.bucket != getattr(cfg, "bucket_index", 0):
+                continue
+            if f.kind == "wire_zero":
+                corrupted = jnp.zeros_like(x)
+            else:
+                corrupted = _bitflip(x, f.bit_mask)
+            where = _leading_mask(x.size, f.count).reshape(x.shape)
+            corrupted = jnp.where(where, corrupted, x)
+            x = jnp.where(_active(f, step, rank), corrupted, x)
+        return x
+
+    return hook
+
+
+def latency_ms(plan: FaultPlan, step: int, bucket: int = 0) -> float:
+    """Total injected collective latency (ms) active at host step ``step``
+    for ``bucket`` — the degraded-fabric model for timing paths."""
+    return float(sum(
+        f.latency_ms for f in plan.latency_faults
+        if f.step <= step < f.step + f.duration
+        and (f.bucket < 0 or f.bucket == bucket)))
+
+
+def with_latency(step_fn, plan: FaultPlan, bucket: int = 0,
+                 sleep=time.sleep):
+    """Wrap a built allreduce/train step with the plan's latency
+    inflation: each call sleeps ``latency_ms`` for its (host-side) step
+    index before dispatching. This is the emulated-mesh seam for
+    exercising timing-sensitive policies (autotune trials, supervisor
+    backoff) under a degraded fabric without a slow wire."""
+    counter = {"step": 0}
+
+    def wrapped(*args, **kwargs):
+        ms = latency_ms(plan, counter["step"], bucket)
+        counter["step"] += 1
+        if ms > 0:
+            sleep(ms / 1e3)
+        return step_fn(*args, **kwargs)
+
+    return wrapped
+
+
+def degraded_fake_ms(base: Callable[[str, int, float], float],
+                     plan: FaultPlan, bucket_of_n: Optional[dict] = None,
+                     step: int = 0) -> Callable[[str, int, float], float]:
+    """Inflate an autotune ``fake_ms`` injector by the plan's latency:
+    models what the trial phase measures on a degraded fabric.
+    ``bucket_of_n`` maps bucket flat sizes to bucket ids (the trial
+    signature carries n, not the bucket index)."""
+
+    def fake(algo: str, n: int, density: float) -> float:
+        b = (bucket_of_n or {}).get(int(n), 0)
+        return float(base(algo, n, density)) + latency_ms(plan, step, b)
+
+    return fake

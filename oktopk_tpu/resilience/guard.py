@@ -1,0 +1,120 @@
+"""Jitted in-step anomaly guard: detect, agree, skip, roll back.
+
+The reference computes gradient sparsity per step and *warns* when it goes
+NaN (VGG/dl_trainer.py:608-609) — the update still applies, and under
+error feedback one poisoned step contaminates the residual forever. Here
+the existing ``grad_nonfinite`` observation becomes an *action*:
+
+1. **detect** — per bucket, count nonfinite elements of the local flat
+   gradient (NaN/Inf never survive a ``>= threshold`` compare, so a
+   poisoned worker would otherwise silently park the NaNs in its residual)
+   plus nonfinite-or-absurd elements of the post-collective reduced
+   vector (wire corruption arrives huge, not necessarily nonfinite:
+   a flipped exponent bit lands near 1e38 — ``abs_limit`` catches it).
+2. **agree** — psum the per-bucket counts over the data axis, so every
+   replica derives the *same* skip decision from the same global flags.
+   Without this, a fault local to one worker would desynchronise params
+   across replicas — the distributed-training equivalent of split brain.
+3. **skip + roll back** — when any bucket trips, the optimizer update is
+   discarded AND the compressor state update (residual, thresholds,
+   drift, boundaries) is rolled back for every bucket, so the step is a
+   pure no-op on training state: params and residuals stay bit-identical
+   to the previous step. Only the step counters advance (cadence
+   bookkeeping; a skipped step still consumed a batch) and the
+   :class:`HealthState` records the trip.
+
+The guard is pure compute inside the traced step — one small psum on top
+of what the step already does — so it costs nothing host-side and works
+identically on the emulated CPU mesh and real hardware.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import flax.struct
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+@dataclasses.dataclass(frozen=True)
+class GuardConfig:
+    """Static guard knobs (hashable; closed over by the jitted step).
+
+    ``abs_limit`` is the sane-gradient magnitude ceiling for the
+    post-collective reduced vector: values beyond it count as anomalies
+    even while finite (wire bit-flips typically produce ~1e38, ten orders
+    of magnitude above any real gradient, without tripping ``isfinite``).
+    """
+
+    abs_limit: float = 1e18
+
+    def __post_init__(self):
+        if not self.abs_limit > 0:
+            raise ValueError(f"abs_limit must be > 0, got {self.abs_limit}")
+
+
+@flax.struct.dataclass
+class HealthState:
+    """Replicated numeric-health counters threaded through the step.
+
+    ``step`` counts *attempted* steps and is the only monotonic step
+    index under the guard (per-bucket SparseState counters also advance
+    on skips, but HealthState is where fault plans and the supervisor
+    index time). ``bucket_trips`` accumulates per-bucket anomaly counts
+    so escalation state survives a checkpoint round-trip.
+    """
+
+    step: jnp.ndarray               # i32 — attempted steps (monotonic)
+    steps_skipped: jnp.ndarray      # i32 — cumulative guard skips
+    last_anomaly_step: jnp.ndarray  # i32 — -1 until the first trip
+    bucket_trips: jnp.ndarray       # i32[num_buckets] — cumulative trips
+
+
+def init_health(num_buckets: int = 1) -> HealthState:
+    nb = max(1, int(num_buckets))
+    return HealthState(
+        step=jnp.asarray(0, jnp.int32),
+        steps_skipped=jnp.asarray(0, jnp.int32),
+        last_anomaly_step=jnp.asarray(-1, jnp.int32),
+        bucket_trips=jnp.zeros((nb,), jnp.int32))
+
+
+def local_anomaly_count(flat: jnp.ndarray, reduced: jnp.ndarray,
+                        cfg: GuardConfig) -> jnp.ndarray:
+    """This worker's anomaly evidence for one bucket (i32 scalar):
+    nonfinite local gradient elements + nonfinite-or-absurd reduced
+    elements. Summed, not flagged, so the count is also the
+    ``grad_nonfinite``-style observability signal."""
+    local_bad = jnp.sum(~jnp.isfinite(flat))
+    wire_bad = jnp.sum(~jnp.isfinite(reduced)
+                       | (jnp.abs(reduced) > cfg.abs_limit))
+    return (local_bad + wire_bad).astype(jnp.int32)
+
+
+def agree(counts, axis_name: str):
+    """psum the stacked per-bucket counts -> (global i32[nb] counts,
+    bool any-anomaly flag). After the psum every replica holds identical
+    values, so the skip decision below is deterministic across the mesh."""
+    total = lax.psum(jnp.stack(counts).astype(jnp.int32), axis_name)
+    return total, jnp.sum(total) > 0
+
+
+def guarded(any_bad, old_tree, new_tree):
+    """``new_tree`` normally; bit-identical ``old_tree`` on a skip."""
+    return jax.tree.map(
+        lambda o, n: jnp.where(any_bad, o, n), old_tree, new_tree)
+
+
+def advance(health: HealthState, any_bad, bucket_counts) -> HealthState:
+    """Post-step health bookkeeping (always advances the attempt
+    counter; a skipped step consumed its batch)."""
+    bad_i = any_bad.astype(jnp.int32)
+    return HealthState(
+        step=health.step + 1,
+        steps_skipped=health.steps_skipped + bad_i,
+        last_anomaly_step=jnp.where(any_bad, health.step,
+                                    health.last_anomaly_step),
+        bucket_trips=health.bucket_trips
+        + (bucket_counts > 0).astype(jnp.int32))

@@ -1,0 +1,61 @@
+"""JSONL health journal — the resilience observability surface.
+
+Same shape (and writer) as the autotuner's decision journal
+(``autotune/journal.py``): line-delimited JSON, append-only, one
+environment header record first so logs are comparable across
+containers/relays. Events (all carry ``event`` and ``step``):
+
+  {"event": "header", "jax": "0.4.37", "jaxlib": ..., "device_kind": ...,
+   "platform": "cpu", "world_size": 8}
+
+  {"event": "fault_seen", "step": 12, "kind": "planned" | "observed",
+   "buckets": [1], "counts": [0, 3]}
+
+  {"event": "guard_trip", "step": 12, "buckets": [1],
+   "consecutive_skips": 1, "strikes": [0, 3]}
+
+  {"event": "fallback", "step": 14, "bucket": 1, "algo": "dense",
+   "strikes": 3}
+
+  {"event": "restore", "step": 30, "ckpt": ".../ckpt-24.msgpack",
+   "last_good_step": 24}
+
+  {"event": "restore_unavailable", "step": 30, "last_good_step": -1}
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+from oktopk_tpu.autotune.journal import DecisionJournal
+
+
+class HealthJournal(DecisionJournal):
+    """Append-only JSONL health log (``path=None`` = in-memory only)."""
+
+    def guard_trip(self, step: int, buckets: Sequence[int],
+                   consecutive_skips: int, strikes: Sequence[int]):
+        return self.record("guard_trip", step=int(step),
+                           buckets=[int(b) for b in buckets],
+                           consecutive_skips=int(consecutive_skips),
+                           strikes=[int(s) for s in strikes])
+
+    def fault_seen(self, step: int, kind: str,
+                   buckets: Sequence[int] = (),
+                   counts: Optional[Sequence[int]] = None):
+        return self.record("fault_seen", step=int(step), kind=kind,
+                           buckets=[int(b) for b in buckets],
+                           counts=(None if counts is None
+                                   else [int(c) for c in counts]))
+
+    def fallback(self, step: int, bucket: int, algo: str, strikes: int):
+        return self.record("fallback", step=int(step), bucket=int(bucket),
+                           algo=algo, strikes=int(strikes))
+
+    def restore(self, step: int, ckpt: Optional[str],
+                last_good_step: int):
+        if ckpt is None:
+            return self.record("restore_unavailable", step=int(step),
+                               last_good_step=int(last_good_step))
+        return self.record("restore", step=int(step), ckpt=ckpt,
+                           last_good_step=int(last_good_step))

@@ -1,0 +1,179 @@
+"""Host-side escalation policy: strikes -> dense fallback -> restore.
+
+The in-step guard (``resilience/guard.py``) makes a single bad step
+harmless; the supervisor handles *persistent* degradation, which a pure
+in-step mechanism cannot (a corrupted link corrupts every retry). The
+escalation ladder, mirroring SparCML's sparse/dense switching
+(arXiv 1802.08021) applied to fault handling instead of performance:
+
+1. **observe** — after each step (on the trainer's check cadence) the
+   supervisor reads the guard's metrics: which buckets tripped, whether
+   the step was skipped.
+2. **strike** — per-bucket strike counters accumulate across trips (a
+   clean step decays them by one rather than resetting: intermittent
+   corruption must still escalate); a consecutive-skip counter tracks
+   run-level divergence.
+3. **fallback** — after ``max_strikes`` on a bucket, that bucket's plan
+   flips to ``dense`` (the trainer rebuilds its jitted step exactly as
+   the autotuner's plan changes do). Dense psum has no sparse payload to
+   corrupt at the wire seam and no residual to poison — it is the safe
+   degraded mode, at 2n volume cost for that bucket only.
+4. **restore** — ``divergence_limit`` consecutive skips mean the run is
+   not making progress (e.g. params already poisoned before the guard
+   was enabled, or every bucket degraded): restore from the last good
+   checkpoint registered via :meth:`note_checkpoint`.
+
+After any escalation the supervisor backs off for ``cooldown_steps``
+before escalating again, so one burst of faults cannot cascade a
+fallback AND a restore from the same evidence.
+
+All state is plain Python ints/lists (:meth:`to_state` /
+:meth:`load_state`) so it checkpoints alongside the train state and a
+resumed run keeps its strike counters and active fallbacks.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from oktopk_tpu.resilience.journal import HealthJournal
+
+
+@dataclasses.dataclass(frozen=True)
+class Action:
+    """One escalation decision for the trainer to execute."""
+
+    kind: str                    # "fallback" | "restore"
+    bucket: int = -1             # fallback target (-1 for restore)
+    ckpt: Optional[str] = None   # restore source (None = unavailable)
+
+
+class Supervisor:
+    """Per-run escalation state machine (host-side, not traced)."""
+
+    def __init__(self, num_buckets: int = 1, max_strikes: int = 3,
+                 divergence_limit: int = 8, cooldown_steps: int = 0,
+                 journal: Optional[HealthJournal] = None):
+        self.num_buckets = max(1, int(num_buckets))
+        self.max_strikes = max(1, int(max_strikes))
+        self.divergence_limit = max(1, int(divergence_limit))
+        self.cooldown_steps = max(0, int(cooldown_steps))
+        self.journal = journal if journal is not None else HealthJournal()
+        self.strikes = [0] * self.num_buckets
+        self.consecutive_skips = 0
+        self.forced_dense: List[int] = []
+        self.last_good_step = -1
+        self.last_good_ckpt: Optional[str] = None
+        self.fallback_events = 0
+        self.restore_events = 0
+        self._cooldown_until = -1
+
+    # ---- inputs -------------------------------------------------------
+
+    def note_checkpoint(self, path: str, step: int) -> None:
+        """Register a checkpoint as a restore candidate. Only checkpoints
+        taken while the run is healthy qualify — restoring into a
+        snapshot saved mid-incident would replay the divergence."""
+        if self.consecutive_skips == 0:
+            self.last_good_ckpt = path
+            self.last_good_step = int(step)
+
+    def observe(self, step: int, metrics: Dict[str, Any]) -> List[Action]:
+        """Digest one step's guard metrics; return escalation actions.
+
+        ``metrics`` needs ``step_skipped`` (0/1) and ``bucket_anomalies``
+        (i32[num_buckets] trip flags) — both emitted by the guarded step.
+        """
+        step = int(step)
+        skipped = bool(int(np.asarray(metrics.get("step_skipped", 0))))
+        flags = np.asarray(metrics.get(
+            "bucket_anomalies", np.zeros(self.num_buckets, np.int32)))
+        actions: List[Action] = []
+        if skipped:
+            self.consecutive_skips += 1
+            tripped = [b for b in range(self.num_buckets)
+                       if b < flags.size and flags[b]]
+            for b in tripped:
+                self.strikes[b] += 1
+            self.journal.guard_trip(step, tripped, self.consecutive_skips,
+                                    self.strikes)
+        else:
+            self.consecutive_skips = 0
+            if self.last_good_step < step:
+                self.last_good_step = step
+            # decay, don't reset: an every-other-step fault must escalate
+            self.strikes = [max(0, s - 1) for s in self.strikes]
+
+        for b in range(self.num_buckets):
+            if (self.strikes[b] >= self.max_strikes
+                    and b not in self.forced_dense
+                    and step >= self._cooldown_until):
+                self.forced_dense.append(b)
+                self.fallback_events += 1
+                self.journal.fallback(step, b, "dense", self.strikes[b])
+                actions.append(Action("fallback", bucket=b))
+                self._cooldown_until = step + self.cooldown_steps
+
+        if (self.consecutive_skips >= self.divergence_limit
+                and step >= self._cooldown_until):
+            self.restore_events += 1
+            self.journal.restore(step, self.last_good_ckpt,
+                                 self.last_good_step)
+            actions.append(Action("restore", ckpt=self.last_good_ckpt))
+            # the restore (or its unavailability) consumed this evidence
+            self.consecutive_skips = 0
+            self._cooldown_until = step + self.cooldown_steps
+        return actions
+
+    # ---- checkpointable state ----------------------------------------
+
+    def to_state(self) -> Dict[str, Any]:
+        """Plain-scalar state for the checkpoint ``extra`` payload."""
+        return {
+            "strikes": [int(s) for s in self.strikes],
+            "consecutive_skips": int(self.consecutive_skips),
+            "forced_dense": [int(b) for b in self.forced_dense],
+            "last_good_step": int(self.last_good_step),
+            "last_good_ckpt": self.last_good_ckpt or "",
+            "fallback_events": int(self.fallback_events),
+            "restore_events": int(self.restore_events),
+        }
+
+    def load_state(self, state: Dict[str, Any]) -> "Supervisor":
+        """Restore counters/fallbacks saved by :meth:`to_state` (tolerant
+        of missing keys, like the checkpoint field merge)."""
+        if not state:
+            return self
+        strikes = [int(s) for s in np.asarray(
+            state.get("strikes", self.strikes)).tolist()]
+        # bucket count changes (replan) keep the overlapping prefix
+        self.strikes = (strikes + [0] * self.num_buckets)[:self.num_buckets]
+        self.consecutive_skips = int(state.get("consecutive_skips", 0))
+        self.forced_dense = sorted(
+            int(b) for b in np.asarray(
+                state.get("forced_dense", [])).reshape(-1).tolist()
+            if 0 <= int(b) < self.num_buckets)
+        self.last_good_step = int(state.get("last_good_step", -1))
+        ck = state.get("last_good_ckpt", "")
+        if isinstance(ck, bytes):
+            ck = ck.decode()
+        self.last_good_ckpt = str(ck) or None
+        self.fallback_events = int(state.get("fallback_events", 0))
+        self.restore_events = int(state.get("restore_events", 0))
+        return self
+
+
+def plan_with_fallbacks(names: Sequence[str], forced_dense: Sequence[int]
+                        ) -> List[str]:
+    """Apply the supervisor's forced-dense set to a per-bucket algorithm
+    plan (autotuned or uniform) — the single place the escalation ladder
+    rewrites a plan, so autotune re-tunes cannot silently resurrect a
+    quarantined bucket's sparse collective."""
+    out = list(names)
+    for b in forced_dense:
+        if 0 <= b < len(out):
+            out[b] = "dense"
+    return out

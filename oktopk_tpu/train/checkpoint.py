@@ -16,6 +16,7 @@ BERT/bert/main_bert.py:73-153) lives in ``oktopk_tpu.train.preemption``.
 
 from __future__ import annotations
 
+import json
 import os
 from typing import Any, Optional, Tuple
 
@@ -25,15 +26,26 @@ import numpy as np
 
 
 def save_checkpoint(ckpt_dir: str, state: Any, step: int,
-                    prefix: str = "ckpt") -> str:
-    """Serialise the full train state to ``<ckpt_dir>/<prefix>-<step>.msgpack``."""
+                    prefix: str = "ckpt",
+                    extra: Optional[dict] = None) -> str:
+    """Serialise the full train state to ``<ckpt_dir>/<prefix>-<step>.msgpack``.
+
+    ``extra`` is an optional side payload of plain scalars/lists (e.g.
+    the resilience supervisor's strike counters and fallback plan,
+    ``Trainer.supervisor_extra``) stored under its own key — it never
+    participates in the train-state pytree merge and is read back with
+    :func:`load_extra`."""
     os.makedirs(ckpt_dir, exist_ok=True)
     host_state = jax.device_get(state)
     path = os.path.join(ckpt_dir, f"{prefix}-{step}.msgpack")
+    payload = {"step": step, "state": host_state}
+    if extra:
+        # JSON-encoded: flax's to_state_dict would rewrite lists into
+        # index-keyed dicts, and the payload is plain scalars anyway
+        payload["extra"] = json.dumps(extra)
     tmp = path + ".tmp"
     with open(tmp, "wb") as f:
-        f.write(flax.serialization.to_bytes({"step": step,
-                                             "state": host_state}))
+        f.write(flax.serialization.to_bytes(payload))
     os.replace(tmp, path)   # atomic publish
     return path
 
@@ -140,6 +152,25 @@ def load_encoder_params(ckpt_dir_or_file: str, params: Any,
     return out
 
 
+def load_extra(ckpt_dir_or_file: str, prefix: str = "ckpt"
+               ) -> Optional[dict]:
+    """The ``extra`` side payload of a checkpoint (None when the file
+    predates it or was saved without one)."""
+    path = ckpt_dir_or_file
+    if os.path.isdir(path):
+        path = latest_checkpoint(path, prefix)
+        if path is None:
+            return None
+    with open(path, "rb") as f:
+        raw = flax.serialization.msgpack_restore(f.read())
+    extra = raw.get("extra")
+    if extra is None:
+        return None
+    if isinstance(extra, bytes):
+        extra = extra.decode()
+    return json.loads(extra)
+
+
 def restore_checkpoint(ckpt_dir_or_file: str, state_template: Any,
                        prefix: str = "ckpt") -> Tuple[Any, int]:
     """Restore into the template's pytree structure; returns (state, step).
@@ -154,6 +185,7 @@ def restore_checkpoint(ckpt_dir_or_file: str, state_template: Any,
             raise FileNotFoundError(f"no checkpoints in {ckpt_dir_or_file}")
     with open(path, "rb") as f:
         raw = flax.serialization.msgpack_restore(f.read())
+    raw.pop("extra", None)   # side payload (load_extra), not train state
     wrapped = {"step": 0, "state": jax.device_get(state_template)}
     defaulted, dropped = [], []
     merged = _merge_missing(flax.serialization.to_state_dict(wrapped), raw,

@@ -60,6 +60,20 @@ def parse_args(argv=None):
                    help="steps between re-tunes (0 = tune once)")
     p.add_argument("--autotune-journal", default=None,
                    help="JSONL decision-journal path (see docs/PERF.md)")
+    p.add_argument("--resilience", action="store_true",
+                   help="numeric-health guard + supervisor (resilience/): "
+                        "psum-agreed skip of anomalous steps with "
+                        "residual rollback, per-bucket dense fallback "
+                        "after repeated strikes, checkpoint restore on "
+                        "divergence")
+    p.add_argument("--resilience-strikes", type=int, default=3,
+                   help="guard trips on a bucket before it falls back "
+                        "to the dense collective")
+    p.add_argument("--resilience-abs-limit", type=float, default=1e18,
+                   help="reduced-gradient magnitude treated as anomalous "
+                        "even while finite (wire bit-flips land ~1e38)")
+    p.add_argument("--resilience-journal", default=None,
+                   help="JSONL health-journal path (docs/RESILIENCE.md)")
     p.add_argument("--density", type=float, default=0.02)
     p.add_argument("--sigma-scale", type=float, default=2.5)
     p.add_argument("--grad-clip", type=float, default=None)
@@ -131,7 +145,11 @@ def main(argv=None):
             s for s in args.autotune_candidates.split(",") if s),
         autotune_trial_steps=args.autotune_trial_steps,
         autotune_retune_every=args.autotune_retune_every,
-        autotune_journal=args.autotune_journal)
+        autotune_journal=args.autotune_journal,
+        resilience=args.resilience,
+        resilience_strikes=args.resilience_strikes,
+        resilience_abs_limit=args.resilience_abs_limit,
+        resilience_journal=args.resilience_journal)
     slug = cfg.experiment_slug()
     # Observability and checkpoints are rank-0 work (the reference gates its
     # writer/checkpointer the same way, VGG/dl_trainer.py:614-616) — on a
@@ -160,11 +178,16 @@ def main(argv=None):
         from oktopk_tpu.train.checkpoint import restore_checkpoint
         trainer.state, start_iter = restore_checkpoint(
             args.resume, trainer.state)
+        # re-arm the escalation ladder: strike counters + any active
+        # per-bucket dense fallbacks resume with the train state
+        trainer.restore_supervisor(args.resume)
         logger.info("resumed from %s at iter %d", args.resume, start_iter)
     elif args.handle_preemption:
         parked = load_interrupted_state(trainer.state)
         if parked is not None:
             trainer.state, start_iter = parked
+            from oktopk_tpu.train.preemption import interrupted_state_path
+            trainer.restore_supervisor(interrupted_state_path() + ".d")
             logger.info("resumed interrupted state at iter %d", start_iter)
 
     # global batch = per-worker batch * workers * accumulation
@@ -225,7 +248,9 @@ def main(argv=None):
             if (is_rank0 and args.ckpt_dir and args.ckpt_every
                     and done % args.ckpt_every == 0):
                 from oktopk_tpu.train.checkpoint import save_checkpoint
-                save_checkpoint(args.ckpt_dir, trainer.state, done)
+                path = save_checkpoint(args.ckpt_dir, trainer.state, done,
+                                       extra=trainer.supervisor_extra())
+                trainer.note_checkpoint(path, done)
     finally:
         if writer is not None:
             writer.close()
@@ -237,7 +262,8 @@ def main(argv=None):
         # main_bert.py:99-153, actually wired here.
         from oktopk_tpu.train.preemption import epilogue
         return epilogue(trainer.state, done, preempt, logger,
-                        rank=jax.process_index(), completed=done >= total)
+                        rank=jax.process_index(), completed=done >= total,
+                        extra=trainer.supervisor_extra())
     return 0
 
 

@@ -84,9 +84,11 @@ def interrupted_state_path(state_dir: str = DEFAULT_STATE_DIR,
 
 def save_interrupted_state(state, step: int,
                            state_dir: str = DEFAULT_STATE_DIR,
-                           job_id: Optional[str] = None) -> str:
+                           job_id: Optional[str] = None,
+                           extra: Optional[dict] = None) -> str:
     """Park the full train state (params + optimizer + sparse residuals and
-    thresholds) for a requeued restart."""
+    thresholds) for a requeued restart. ``extra`` rides along like in
+    ``checkpoint.save_checkpoint`` (e.g. supervisor escalation state)."""
     from oktopk_tpu.train.checkpoint import save_checkpoint
 
     path = interrupted_state_path(state_dir, job_id)
@@ -95,7 +97,7 @@ def save_interrupted_state(state, step: int,
     # under a jobid-keyed subdir so the latest one is unambiguous.
     d, base = os.path.split(path)
     sub = os.path.join(d, base + ".d")
-    return save_checkpoint(sub, state, step)
+    return save_checkpoint(sub, state, step, extra=extra)
 
 
 def load_interrupted_state(state_template,
@@ -123,7 +125,8 @@ def clear_interrupted_state(state_dir: str = DEFAULT_STATE_DIR,
 
 def epilogue(state, last_step: int, preempt: "PreemptionHandler", logger,
              rank: int = 0, completed: bool = False,
-             state_dir: str = DEFAULT_STATE_DIR) -> int:
+             state_dir: str = DEFAULT_STATE_DIR,
+             extra: Optional[dict] = None) -> int:
     """Shared driver exit path. If ``preempt`` fired before the run finished:
     park state (rank 0), requeue when requested, and return exit code 3.
     Otherwise clear any parked state for this job id (a completed run must
@@ -131,7 +134,8 @@ def epilogue(state, last_step: int, preempt: "PreemptionHandler", logger,
     if preempt is not None and preempt.should_stop() and not completed:
         if rank == 0:
             path = save_interrupted_state(state, last_step,
-                                          state_dir=state_dir)
+                                          state_dir=state_dir,
+                                          extra=extra)
             logger.info("preempted @ step %d: state parked at %s",
                         last_step, path)
         if preempt.requeue_requested and requeue_job(rank=rank):

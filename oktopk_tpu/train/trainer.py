@@ -59,7 +59,8 @@ class Trainer:
                  algo_cfg: Optional[OkTopkConfig] = None,
                  model_kwargs: Optional[Dict[str, Any]] = None,
                  axis_name: str = "data", warmup: bool = True,
-                 profile_norm: Optional[bool] = None):
+                 profile_norm: Optional[bool] = None,
+                 fault_plan=None):
         from oktopk_tpu import settings
         if profile_norm is None:
             profile_norm = settings.PROFILING_NORM
@@ -116,22 +117,62 @@ class Trainer:
 
         self._warmup = warmup
         self._profile_norm = profile_norm
+
+        # ---- numeric-health guard + supervisor (resilience/) ----------
+        self._fault_plan = fault_plan
+        self._guard = None
+        self.supervisor = None
+        if cfg.resilience:
+            from oktopk_tpu.resilience import (GuardConfig, HealthJournal,
+                                               Supervisor)
+            self._guard = GuardConfig(abs_limit=cfg.resilience_abs_limit)
+            self.supervisor = Supervisor(
+                num_buckets=cfg.num_buckets,
+                max_strikes=cfg.resilience_strikes,
+                divergence_limit=cfg.resilience_divergence_limit,
+                cooldown_steps=cfg.resilience_cooldown,
+                journal=HealthJournal(cfg.resilience_journal))
+            if fault_plan is not None:
+                # chaos drill: announce the planned schedule up front so
+                # the journal distinguishes drills from real corruption
+                for f in fault_plan.faults:
+                    self.supervisor.journal.fault_seen(
+                        f.step, f"planned:{f.kind}", buckets=[f.bucket])
+
         self.state = init_dist_state(
             params, self.model_state, self.optimizer, self.algo_cfg,
             momentum_correction=bool(self._mc_factor),
-            num_buckets=cfg.num_buckets)
+            num_buckets=cfg.num_buckets,
+            with_health=self._with_health)
         self.autotuner = None      # built lazily by autotune()
         self._plans = None         # per-bucket BucketPlan list, or None
         self.step_fn = self._build_step()
         self._rng = jax.random.PRNGKey(cfg.seed + 1)
         self.metrics_history = []
 
+    @property
+    def _with_health(self) -> bool:
+        return self._guard is not None or self._fault_plan is not None
+
+    @property
+    def _forced_dense(self):
+        return self.supervisor.forced_dense if self.supervisor else ()
+
     def _build_step(self):
+        nb = max(1, self.cfg.num_buckets)
         compressor = self.cfg.compressor
         densities = None
         if self._plans:
             compressor = [p.algo for p in self._plans]
             densities = [p.density for p in self._plans]
+        if self._forced_dense:
+            from oktopk_tpu.resilience.supervisor import plan_with_fallbacks
+            names = (list(compressor) if not isinstance(compressor, str)
+                     else [compressor] * nb)
+            compressor = plan_with_fallbacks(names, self._forced_dense)
+            if densities is not None:
+                densities = [1.0 if b in self._forced_dense else d
+                             for b, d in enumerate(densities)]
         return build_sparse_grad_step(
             self._loss_fn, self.optimizer, self.algo_cfg, self.mesh,
             compressor=compressor, axis_name=self.axis_name,
@@ -140,7 +181,8 @@ class Trainer:
             profile_norm=self._profile_norm,
             momentum_correction=self._mc_factor,
             num_buckets=self.cfg.num_buckets,
-            bucket_densities=densities)
+            bucket_densities=densities,
+            guard=self._guard, fault_plan=self._fault_plan)
 
     # ---- autotuning ---------------------------------------------------
 
@@ -195,6 +237,52 @@ class Trainer:
             return
         if self.autotuner is None or self.autotuner.should_retune(step):
             self.autotune(step=step)
+
+    # ---- resilience supervision ---------------------------------------
+
+    def supervise(self, step: int, metrics) -> None:
+        """Feed one step's guard metrics to the supervisor and execute
+        whatever it escalates to: a per-bucket dense fallback rebuilds
+        the jitted step exactly like an autotune plan change; a restore
+        reloads the last good checkpoint registered via
+        :meth:`note_checkpoint` (journalled either way)."""
+        if self.supervisor is None:
+            return
+        host = {k: np.asarray(metrics[k])
+                for k in ("step_skipped", "bucket_anomalies")
+                if k in metrics}
+        for act in self.supervisor.observe(step, host):
+            if act.kind == "fallback":
+                # forced_dense already updated by the supervisor
+                self.step_fn = self._build_step()
+            elif act.kind == "restore" and act.ckpt:
+                from oktopk_tpu.train.checkpoint import restore_checkpoint
+                self.state, _ = restore_checkpoint(act.ckpt, self.state)
+
+    def note_checkpoint(self, path: str, step: int) -> None:
+        """Register a saved checkpoint as a restore candidate (and record
+        the supervisor's own state next to it, see ``supervisor_extra``)."""
+        if self.supervisor is not None:
+            self.supervisor.note_checkpoint(path, step)
+
+    def supervisor_extra(self):
+        """The ``extra`` payload for ``checkpoint.save_checkpoint``: the
+        supervisor's strike counters, active fallbacks, and last-good
+        marker, so a resumed run keeps its escalation state."""
+        if self.supervisor is None:
+            return None
+        return {"supervisor": self.supervisor.to_state()}
+
+    def restore_supervisor(self, ckpt_dir_or_file: str) -> None:
+        """Re-arm the supervisor from a checkpoint's extra payload and
+        re-apply its per-bucket fallbacks to the jitted step."""
+        if self.supervisor is None:
+            return
+        from oktopk_tpu.train.checkpoint import load_extra
+        extra = load_extra(ckpt_dir_or_file) or {}
+        self.supervisor.load_state(extra.get("supervisor") or {})
+        if self.supervisor.forced_dense:
+            self.step_fn = self._build_step()
 
     # ---- workload-specific pieces -------------------------------------
 
@@ -323,6 +411,12 @@ class Trainer:
             else:
                 batch = next(data_iter)
                 metrics = self.train_step(batch)
+            if (self.supervisor is not None
+                    and step % max(1, self.cfg.resilience_check_every) == 0):
+                # reacting to guard trips costs a device sync on the
+                # check cadence; escalation may rebuild step_fn or
+                # restore state before the next iteration
+                self.supervise(step, metrics)
             if metric_writer is not None:
                 pending.append((step, metrics))
             if "grad_nonfinite" in metrics:
@@ -381,7 +475,8 @@ class Trainer:
         self.state = init_dist_state(
             old[0], old[1], self.optimizer, self.algo_cfg,
             momentum_correction=bool(self._mc_factor), opt_state=old[2],
-            num_buckets=self.cfg.num_buckets)
+            num_buckets=self.cfg.num_buckets,
+            with_health=self._with_health)
         # trial measurements were taken on the old topology: drop the
         # tuner (it re-tunes against the new mesh on the next cadence)
         # but keep the current plan so the rebuilt step stays consistent

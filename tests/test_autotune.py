@@ -199,8 +199,13 @@ class TestJournal:
             for line in f:
                 json.loads(line)                 # every line parses alone
         entries = read_journal(path)
-        assert entries[0]["event"] == "calibration"
-        assert {"alpha", "beta", "source"} <= set(entries[0])
+        # every journal leads with the environment header so decision
+        # logs are comparable across containers/relays
+        assert entries[0]["event"] == "header"
+        assert {"jax", "jaxlib", "device_kind", "world_size"} \
+            <= set(entries[0])
+        assert entries[1]["event"] == "calibration"
+        assert {"alpha", "beta", "source"} <= set(entries[1])
         decisions = [e for e in entries if e["event"] == "decision"]
         assert len(decisions) == 2
         for d in decisions:
@@ -214,7 +219,8 @@ class TestJournal:
     def test_memory_only_journal(self):
         j = DecisionJournal()
         j.record("calibration", step=0, alpha=1e-6)
-        assert j.entries[0]["alpha"] == 1e-6
+        assert j.entries[0]["event"] == "header"
+        assert j.entries[-1]["alpha"] == 1e-6
 
 
 class TestTrainerIntegration:

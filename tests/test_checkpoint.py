@@ -135,6 +135,29 @@ class TestCheckpoint:
             load_encoder_params(
                 str(tmp_path), {"bert": {"w": np.zeros((2, 2), np.float32)}})
 
+    def test_extra_payload_roundtrip(self, trained, tmp_path):
+        """The JSON ``extra`` side payload survives the msgpack container
+        verbatim (lists stay lists — flax's to_state_dict would have
+        rewritten them into index-keyed dicts) and never disturbs the
+        train-state restore."""
+        from oktopk_tpu.train.checkpoint import load_extra
+
+        extra = {"supervisor": {"strikes": [0, 2], "forced_dense": [1],
+                                "last_good_step": 3}}
+        save_checkpoint(str(tmp_path), trained.state, step=3, extra=extra)
+        assert load_extra(str(tmp_path)) == extra
+        fresh = Trainer(trained.cfg, mesh=trained.mesh, warmup=False)
+        restored, step = restore_checkpoint(str(tmp_path), fresh.state)
+        assert step == 3
+        np.testing.assert_array_equal(
+            np.asarray(restored.sparse_state.residual),
+            np.asarray(trained.state.sparse_state.residual))
+
+    def test_extra_absent_returns_none(self, trained, tmp_path):
+        save_checkpoint(str(tmp_path), trained.state, step=1)
+        from oktopk_tpu.train.checkpoint import load_extra
+        assert load_extra(str(tmp_path)) is None
+
     def test_restore_tolerates_missing_new_fields(self, trained, tmp_path):
         """A checkpoint saved before a DistTrainState field existed must
         still restore, keeping the template's fresh value for the new field
@@ -157,3 +180,70 @@ class TestCheckpoint:
         np.testing.assert_array_equal(
             np.asarray(jax.tree.leaves(restored.params)[0]),
             np.asarray(jax.tree.leaves(trained.state.params)[0]))
+
+
+class TestSupervisorCheckpoint:
+    """Checkpoint round-trip of resilience state: strike counters, the
+    active per-bucket fallback plan, the last-good-step marker, and the
+    in-state health counters all survive a save/restore."""
+
+    @pytest.fixture(scope="class")
+    def resilient(self, mesh4):
+        from oktopk_tpu.config import OkTopkConfig
+        cfg = TrainConfig(dnn="mnistnet", dataset="mnist", batch_size=8,
+                          lr=0.05, compressor="oktopk", density=0.05,
+                          num_buckets=2, resilience=True)
+        tr = Trainer(cfg, mesh=mesh4, warmup=False,
+                     algo_cfg=OkTopkConfig(warmup_steps=0))
+        it = synthetic_iterator("mnistnet", 8, seed=13)
+        for _ in range(2):
+            tr.train_step(next(it))
+        # escalate bucket 1 to dense via fabricated guard evidence
+        skip = {"step_skipped": np.int32(1),
+                "bucket_anomalies": np.asarray([0, 1], np.int32)}
+        for step in (3, 4, 5):
+            tr.supervise(step, skip)
+        assert tr.supervisor.forced_dense == [1]
+        return tr
+
+    def test_supervisor_state_roundtrip(self, resilient, tmp_path):
+        from oktopk_tpu.train.checkpoint import load_extra
+        path = save_checkpoint(str(tmp_path), resilient.state, step=5,
+                               extra=resilient.supervisor_extra())
+        resilient.note_checkpoint(path, 5)
+
+        fresh = Trainer(resilient.cfg, mesh=resilient.mesh, warmup=False,
+                        algo_cfg=resilient.algo_cfg)
+        fresh.state, step = restore_checkpoint(str(tmp_path), fresh.state)
+        fresh.restore_supervisor(str(tmp_path))
+        assert step == 5
+        assert fresh.supervisor.strikes == resilient.supervisor.strikes
+        assert fresh.supervisor.forced_dense == [1]
+        assert fresh.supervisor.fallback_events \
+            == resilient.supervisor.fallback_events
+        sup = load_extra(str(tmp_path))["supervisor"]
+        assert sup["last_good_step"] == resilient.supervisor.last_good_step
+        # health counters rode along inside DistTrainState
+        assert int(fresh.state.health.step) == int(resilient.state.health.step)
+        assert int(fresh.state.health.steps_skipped) \
+            == int(resilient.state.health.steps_skipped)
+        # the re-armed trainer still steps, with bucket 1 forced dense
+        it = synthetic_iterator("mnistnet", 8, seed=14)
+        m = fresh.train_step(next(it))
+        assert np.isfinite(float(m["loss"]))
+
+    def test_pre_resilience_checkpoint_restores_into_guarded_state(
+            self, trained, tmp_path):
+        """A checkpoint saved WITHOUT health (older run / guard off) must
+        restore into a guarded trainer, keeping the fresh health field."""
+        import dataclasses
+        save_checkpoint(str(tmp_path), trained.state, step=3)
+        cfg = dataclasses.replace(trained.cfg, resilience=True)
+        fresh = Trainer(cfg, mesh=trained.mesh, warmup=False)
+        before = int(fresh.state.health.step)
+        restored, _ = restore_checkpoint(str(tmp_path), fresh.state)
+        assert restored.health is not None
+        assert int(restored.health.step) == before
+        np.testing.assert_array_equal(
+            np.asarray(restored.sparse_state.residual),
+            np.asarray(trained.state.sparse_state.residual))

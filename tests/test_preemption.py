@@ -68,6 +68,20 @@ class TestInterruptedState:
         assert load_interrupted_state({}, state_dir=str(tmp_path),
                                       job_id="nope") is None
 
+    def test_extra_rides_along(self, tmp_path):
+        """Supervisor escalation state parks with the interrupted state
+        (the requeued run must keep its strike counters and fallbacks)."""
+        from oktopk_tpu.train.checkpoint import load_extra
+        from oktopk_tpu.train.preemption import interrupted_state_path
+
+        extra = {"supervisor": {"strikes": [1, 0], "forced_dense": [0],
+                                "last_good_step": 11}}
+        save_interrupted_state({"w": np.zeros(2)}, 12,
+                               state_dir=str(tmp_path), job_id="j9",
+                               extra=extra)
+        parked = interrupted_state_path(str(tmp_path), job_id="j9") + ".d"
+        assert load_extra(parked) == extra
+
     def test_clear(self, tmp_path):
         save_interrupted_state({"x": np.zeros(2)}, 1,
                                state_dir=str(tmp_path), job_id="j2")

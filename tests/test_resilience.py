@@ -1,0 +1,485 @@
+"""Resilience subsystem tests (oktopk_tpu/resilience/).
+
+The reference only *warns* on NaN gradient sparsity
+(VGG/dl_trainer.py:608-609); under error feedback one bad step poisons
+the residual forever. These tests drive the full ladder on the emulated
+mesh: deterministic fault injection -> psum-agreed in-step skip with
+bit-identical rollback -> per-bucket dense fallback -> checkpoint
+restore. Multi-step injection drills carry the ``chaos`` marker; the
+guard/supervisor unit subset stays unmarked for the fast tier-1 path.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from oktopk_tpu.collectives import wire
+from oktopk_tpu.config import OkTopkConfig, TrainConfig
+from oktopk_tpu.data.synthetic import synthetic_batch
+from oktopk_tpu.resilience import (
+    FaultPlan,
+    FaultSpec,
+    GuardConfig,
+    HealthJournal,
+    Supervisor,
+    init_health,
+    inject_grad_faults,
+    latency_ms,
+    make_wire_hook,
+    with_latency,
+)
+from oktopk_tpu.resilience.faults import _bitflip, degraded_fake_ms
+from oktopk_tpu.resilience.guard import (
+    advance,
+    guarded,
+    local_anomaly_count,
+)
+from oktopk_tpu.resilience.supervisor import plan_with_fallbacks
+from oktopk_tpu.train.trainer import Trainer
+
+# never-firing plan: same traced op structure as a firing one (the
+# activity predicate just stays False), so control runs share numerics
+NEVER = 10**9
+
+
+def _trainer(mesh, fault_plan=None, num_buckets=1, **cfg_over):
+    kw = dict(dnn="mnistnet", dataset="mnist", batch_size=8,
+              lr=0.05, compressor="oktopk", density=0.05,
+              num_buckets=num_buckets, resilience=True,
+              resilience_cooldown=0)
+    kw.update(cfg_over)
+    cfg = TrainConfig(**kw)
+    # cadence 1 everywhere: every step recomputes thresholds/regions
+    # exactly, so trajectories are step-counter independent and the
+    # shifted-by-one equivalence below is exact
+    acfg = OkTopkConfig(warmup_steps=0, local_recompute_every=1,
+                        global_recompute_every=1, repartition_every=1)
+    return Trainer(cfg, mesh=mesh, warmup=False, algo_cfg=acfg,
+                   fault_plan=fault_plan)
+
+
+def _batches(n, seed=9):
+    rng = np.random.RandomState(seed)
+    return [synthetic_batch("mnistnet", 8, rng) for _ in range(n)]
+
+
+def _leaves_equal(a, b):
+    return all(np.array_equal(np.asarray(x), np.asarray(y), equal_nan=True)
+               for x, y in zip(jax.tree.leaves(a), jax.tree.leaves(b)))
+
+
+# ---------------------------------------------------------------------------
+
+
+class TestFaultPlan:
+    def test_spec_validation(self):
+        with pytest.raises(ValueError, match="unknown fault kind"):
+            FaultSpec("meteor_strike", step=0)
+        with pytest.raises(ValueError, match="duration"):
+            FaultSpec("nan_grad", step=0, duration=0)
+        with pytest.raises(ValueError, match="step"):
+            FaultSpec("nan_grad", step=-1)
+
+    def test_plan_kind_filters(self):
+        plan = FaultPlan((FaultSpec("nan_grad", 1),
+                          FaultSpec("wire_zero", 2),
+                          FaultSpec("latency", 3, latency_ms=5.0)))
+        assert len(plan.grad_faults) == 1
+        assert len(plan.wire_faults) == 1
+        assert len(plan.latency_faults) == 1
+
+    def test_grad_injection_is_step_and_worker_exact(self):
+        plan = FaultPlan((FaultSpec("nan_grad", step=3, worker=1, count=2),))
+        flat = jnp.ones((6,))
+        hit = inject_grad_faults(plan, flat, jnp.int32(3), jnp.int32(1), 0)
+        assert int(jnp.sum(~jnp.isfinite(hit))) == 2
+        for step, rank in ((2, 1), (4, 1), (3, 0)):
+            out = inject_grad_faults(plan, flat, jnp.int32(step),
+                                     jnp.int32(rank), 0)
+            np.testing.assert_array_equal(np.asarray(out), np.asarray(flat))
+
+    def test_inf_and_bucket_targeting(self):
+        plan = FaultPlan((FaultSpec("inf_grad", step=0, bucket=1),))
+        flat = jnp.ones((4,))
+        miss = inject_grad_faults(plan, flat, jnp.int32(0), jnp.int32(0), 0)
+        hit = inject_grad_faults(plan, flat, jnp.int32(0), jnp.int32(0), 1)
+        np.testing.assert_array_equal(np.asarray(miss), np.asarray(flat))
+        assert bool(jnp.all(jnp.isinf(hit)))
+
+    def test_bitflip_deterministic_and_detectable(self):
+        x = jnp.linspace(0.01, 1.5, 16, dtype=jnp.float32)
+        a, b = _bitflip(x, 0), _bitflip(x, 0)
+        assert np.array_equal(np.asarray(a), np.asarray(b), equal_nan=True)
+
+        # top-exponent-bit flip: |x| < 1 lands ~1e38 (finite but ten-plus
+        # orders beyond any sane gradient, caught by abs_limit), |x| in
+        # [1, 2) saturates the exponent into inf/nan — either way every
+        # flipped element must register as anomalous to the guard
+        def all_anomalous(y):
+            bad = ~jnp.isfinite(y) | (jnp.abs(y) > GuardConfig().abs_limit)
+            return bool(jnp.all(bad))
+
+        assert all_anomalous(a)
+        xb = x.astype(jnp.bfloat16)
+        ab = _bitflip(xb, 0)
+        assert ab.dtype == jnp.bfloat16
+        assert all_anomalous(ab.astype(jnp.float32))
+
+    def test_latency_pure(self):
+        plan = FaultPlan((
+            FaultSpec("latency", step=2, duration=3, latency_ms=7.0),
+            FaultSpec("latency", step=3, bucket=1, latency_ms=5.0)))
+        assert latency_ms(plan, 1) == 0.0
+        assert latency_ms(plan, 2) == 7.0
+        assert latency_ms(plan, 3, bucket=1) == 12.0
+        assert latency_ms(plan, 3, bucket=0) == 7.0
+        assert latency_ms(plan, 5) == 0.0
+
+    def test_with_latency_sleeps_on_schedule(self):
+        plan = FaultPlan((FaultSpec("latency", step=1, latency_ms=250.0),))
+        slept, calls = [], []
+        wrapped = with_latency(lambda x: calls.append(x) or x, plan,
+                               sleep=slept.append)
+        assert wrapped(1) == 1 and wrapped(2) == 2 and wrapped(3) == 3
+        assert calls == [1, 2, 3]
+        assert slept == [0.25]
+
+    def test_degraded_fake_ms(self):
+        plan = FaultPlan((FaultSpec("latency", step=0, bucket=1,
+                                    latency_ms=9.0),))
+        fake = degraded_fake_ms(lambda a, n, d: 1.0, plan,
+                                bucket_of_n={100: 0, 200: 1})
+        assert fake("oktopk", 100, 0.1) == 1.0
+        assert fake("oktopk", 200, 0.1) == 10.0
+
+
+class TestGuardUnits:
+    def test_config_validation(self):
+        with pytest.raises(ValueError):
+            GuardConfig(abs_limit=0.0)
+
+    def test_local_anomaly_count(self):
+        g = GuardConfig(abs_limit=1e6)
+        clean = jnp.ones((8,))
+        assert int(local_anomaly_count(clean, clean, g)) == 0
+        naned = clean.at[2].set(jnp.nan)
+        assert int(local_anomaly_count(naned, clean, g)) == 1
+        infed = clean.at[1].set(jnp.inf).at[3].set(-jnp.inf)
+        assert int(local_anomaly_count(clean, infed, g)) == 2
+        huge = clean.at[0].set(1e7)   # finite but absurd: wire bit-flip
+        assert int(local_anomaly_count(clean, huge, g)) == 1
+
+    def test_guarded_select(self):
+        old = {"w": jnp.zeros((3,)), "i": jnp.asarray(1, jnp.int32)}
+        new = {"w": jnp.ones((3,)), "i": jnp.asarray(2, jnp.int32)}
+        assert _leaves_equal(guarded(jnp.asarray(True), old, new), old)
+        assert _leaves_equal(guarded(jnp.asarray(False), old, new), new)
+
+    def test_health_advance(self):
+        h = init_health(2)
+        h1 = advance(h, jnp.asarray(False), jnp.zeros((2,), jnp.int32))
+        assert int(h1.step) == 1 and int(h1.steps_skipped) == 0
+        assert int(h1.last_anomaly_step) == -1
+        h2 = advance(h1, jnp.asarray(True),
+                     jnp.asarray([0, 3], jnp.int32))
+        assert int(h2.step) == 2 and int(h2.steps_skipped) == 1
+        assert int(h2.last_anomaly_step) == 1
+        np.testing.assert_array_equal(np.asarray(h2.bucket_trips), [0, 1])
+
+
+# ---------------------------------------------------------------------------
+
+
+class TestGuardedStep:
+    """Acceptance: a FaultPlan injecting NaN grads at step k yields a
+    deterministic all-replica skip at step k — params and residuals
+    bit-identical to their step-(k-1) values — and the loss trajectory
+    thereafter matches a fault-free run shifted by one step."""
+
+    K = 2          # 0-based attempted-step index of the injected fault
+    STEPS = 5
+
+    @pytest.fixture(scope="class")
+    def faulted(self, mesh4):
+        plan = FaultPlan((FaultSpec("nan_grad", step=self.K, worker=1,
+                                    count=3),))
+        return _trainer(mesh4, fault_plan=plan)
+
+    @pytest.fixture(scope="class")
+    def run(self, faulted):
+        """Drive step_fn directly with explicit rngs so the control run
+        below can replay the identical (batch, rng) stream."""
+        batches = _batches(self.STEPS)
+        rngs = [jax.random.PRNGKey(100 + i) for i in range(self.STEPS)]
+        states = [faulted.state]
+        metrics = []
+        s = faulted.state
+        for b, r in zip(batches, rngs):
+            s, m = faulted.step_fn(s, b, r)
+            states.append(jax.device_get(s))
+            metrics.append(jax.device_get(m))
+        return batches, rngs, states, metrics
+
+    def test_skip_is_deterministic_and_bit_identical(self, run):
+        _, _, states, metrics = run
+        skips = [int(m["step_skipped"]) for m in metrics]
+        assert skips == [1 if i == self.K else 0
+                         for i in range(self.STEPS)]
+        before, after = states[self.K], states[self.K + 1]
+        assert _leaves_equal(before.params, after.params)
+        assert _leaves_equal(before.opt_state, after.opt_state)
+        np.testing.assert_array_equal(
+            np.asarray(before.sparse_state.residual),
+            np.asarray(after.sparse_state.residual))
+        np.testing.assert_array_equal(
+            np.asarray(before.sparse_state.local_threshold),
+            np.asarray(after.sparse_state.local_threshold))
+        # counters still advanced: the skipped step consumed its batch
+        assert int(after.sparse_state.step[0]) \
+            == int(before.sparse_state.step[0]) + 1
+        assert int(after.health.steps_skipped) == 1
+        assert int(after.health.last_anomaly_step) == self.K
+
+    @pytest.mark.chaos
+    def test_trajectory_matches_fault_free_shifted_by_one(self, mesh4,
+                                                          run):
+        batches, rngs, states, metrics = run
+        # identical spec except the never-reached step index: the control
+        # program traces the same op graph, so numerics match bit-exactly
+        control = _trainer(
+            mesh4, fault_plan=FaultPlan((FaultSpec("nan_grad", NEVER,
+                                                   worker=1, count=3),)))
+        s = control.state
+        ctl_losses = []
+        for i in range(self.STEPS):
+            if i == self.K:
+                continue   # the faulted run's step k delivered nothing
+            s, m = control.step_fn(s, batches[i], rngs[i])
+            ctl_losses.append(float(m["loss"]))
+        fau_losses = [float(m["loss"]) for i, m in enumerate(metrics)
+                      if i != self.K]
+        assert fau_losses == ctl_losses
+        final = jax.device_get(s)
+        assert _leaves_equal(final.params, states[-1].params)
+        np.testing.assert_array_equal(
+            np.asarray(final.sparse_state.residual),
+            np.asarray(states[-1].sparse_state.residual))
+
+    @pytest.mark.chaos
+    @pytest.mark.slow
+    def test_unguarded_run_is_poisoned(self, mesh4):
+        """The failure mode the guard exists for: without it, a NaN step
+        contaminates the residual (NaN never beats a threshold compare,
+        so it parks in error feedback; only the few slots that later WIN
+        globally from other workers' mass get discarded-to-zero) — the
+        reference's warn-only behaviour."""
+        plan = FaultPlan((FaultSpec("nan_grad", step=1, worker=1),))
+        tr = _trainer(mesh4, fault_plan=plan, resilience=False)
+        assert tr.supervisor is None and tr._guard is None
+        for b in _batches(3):
+            m = tr.train_step(b)
+        res = np.asarray(tr.state.sparse_state.residual)
+        # worker 1's residual row stays poisoned two steps after the
+        # fault; the healthy workers' rows are untouched
+        assert not np.isfinite(res[1]).all()
+        assert np.isfinite(res[0]).all()
+        assert "step_skipped" not in m
+
+
+# ---------------------------------------------------------------------------
+
+
+class TestWireCorruption:
+    """Acceptance: >= N repeated wire-corruption faults on one bucket
+    cause the supervisor to flip exactly that bucket to dense (the other
+    bucket keeps its sparse plan), recorded in the resilience journal."""
+
+    @pytest.mark.chaos
+    def test_bitflip_escalates_to_dense_on_that_bucket_only(self, mesh4,
+                                                            tmp_path):
+        plan = FaultPlan((FaultSpec("wire_bitflip", step=1, duration=20,
+                                    worker=2, bucket=1),))
+        prev = wire.install_wire_fault(make_wire_hook(plan))
+        try:
+            tr = _trainer(mesh4, num_buckets=2, resilience_strikes=3,
+                          resilience_journal=str(tmp_path / "health.jsonl"))
+            skips = []
+            for i, b in enumerate(_batches(7)):
+                m = tr.train_step(b)
+                tr.supervise(i + 1, m)
+                skips.append(int(m["step_skipped"]))
+        finally:
+            wire.install_wire_fault(prev)
+        # 3 strikes on bucket 1, then the fallback quarantines it: the
+        # still-active wire fault has no sparse payload left to corrupt
+        assert skips == [0, 1, 1, 1, 0, 0, 0]
+        assert list(tr.supervisor.forced_dense) == [1]
+        assert tr.supervisor.fallback_events == 1
+        from oktopk_tpu.autotune.journal import read_journal
+        entries = read_journal(str(tmp_path / "health.jsonl"))
+        assert entries[0]["event"] == "header"
+        assert {"jax", "device_kind", "world_size"} <= set(entries[0])
+        falls = [e for e in entries if e["event"] == "fallback"]
+        assert [f["bucket"] for f in falls] == [1]
+        trips = [e for e in entries if e["event"] == "guard_trip"]
+        assert len(trips) == 3
+        assert all(e["buckets"] == [1] for e in trips)
+
+    @pytest.mark.chaos
+    def test_zeroed_payload_recovered_by_error_feedback(self, mesh4):
+        """Zeroed winners are not anomalies: the senders keep the mass in
+        their residual (winner_mask never fires at zeroed slots), so the
+        guard must NOT trip and training must stay finite."""
+        plan = FaultPlan((FaultSpec("wire_zero", step=1, duration=2),))
+        prev = wire.install_wire_fault(make_wire_hook(plan))
+        try:
+            tr = _trainer(mesh4)
+            for i, b in enumerate(_batches(4)):
+                m = tr.train_step(b)
+                assert int(m["step_skipped"]) == 0
+                assert np.isfinite(float(m["loss"]))
+        finally:
+            wire.install_wire_fault(prev)
+        assert int(tr.state.health.steps_skipped) == 0
+        assert np.isfinite(
+            np.asarray(tr.state.sparse_state.residual)).all()
+
+
+# ---------------------------------------------------------------------------
+
+
+class TestSupervisor:
+    def _skip(self, buckets, nb=2):
+        flags = np.zeros(nb, np.int32)
+        flags[list(buckets)] = 1
+        return {"step_skipped": 1, "bucket_anomalies": flags}
+
+    CLEAN = {"step_skipped": 0, "bucket_anomalies": np.zeros(2, np.int32)}
+
+    def test_strikes_escalate_to_fallback(self):
+        sup = Supervisor(num_buckets=2, max_strikes=3)
+        acts = []
+        for step in range(1, 4):
+            acts += sup.observe(step, self._skip([1]))
+        assert [a.kind for a in acts] == ["fallback"]
+        assert acts[0].bucket == 1
+        assert sup.forced_dense == [1]
+        # already quarantined: more strikes do not re-escalate
+        assert sup.observe(4, self._skip([1])) == []
+
+    def test_clean_steps_decay_but_do_not_reset(self):
+        sup = Supervisor(num_buckets=2, max_strikes=3)
+        sup.observe(1, self._skip([0]))
+        sup.observe(2, self._skip([0]))
+        sup.observe(3, self.CLEAN)          # decay: 2 -> 1
+        assert sup.strikes[0] == 1
+        sup.observe(4, self._skip([0]))     # 2
+        acts = sup.observe(5, self._skip([0]))
+        assert [a.kind for a in acts] == ["fallback"]
+
+    def test_divergence_restores_from_last_good(self):
+        sup = Supervisor(num_buckets=1, divergence_limit=3)
+        sup.note_checkpoint("/ck/ckpt-7.msgpack", 7)
+        acts = []
+        for step in range(8, 11):
+            acts += sup.observe(step, self._skip([0], nb=1))
+        restores = [a for a in acts if a.kind == "restore"]
+        assert len(restores) == 1
+        assert restores[0].ckpt == "/ck/ckpt-7.msgpack"
+        assert sup.restore_events == 1
+        assert sup.consecutive_skips == 0   # evidence consumed
+
+    def test_restore_unavailable_is_journalled(self):
+        sup = Supervisor(num_buckets=1, divergence_limit=2)
+        for step in (1, 2):
+            sup.observe(step, self._skip([0], nb=1))
+        events = [e["event"] for e in sup.journal.entries]
+        assert "restore_unavailable" in events
+
+    def test_checkpoint_mid_incident_is_not_good(self):
+        sup = Supervisor(num_buckets=1)
+        sup.observe(1, self._skip([0], nb=1))
+        sup.note_checkpoint("/ck/bad.msgpack", 1)
+        assert sup.last_good_ckpt is None
+
+    def test_cooldown_spaces_escalations(self):
+        sup = Supervisor(num_buckets=2, max_strikes=2, cooldown_steps=5)
+        acts = []
+        for step in range(1, 5):
+            acts += sup.observe(step, self._skip([0, 1]))
+        # both buckets earn fallback evidence, but the second waits out
+        # the cooldown window
+        assert [a.bucket for a in acts if a.kind == "fallback"] == [0]
+        acts2 = sup.observe(7, self._skip([0, 1]))
+        assert [a.bucket for a in acts2 if a.kind == "fallback"] == [1]
+
+    def test_state_roundtrip(self):
+        sup = Supervisor(num_buckets=3, max_strikes=2)
+        sup.observe(1, self._skip([1], nb=3))
+        sup.observe(2, self._skip([1], nb=3))
+        # a clean step ends the incident; only now may a checkpoint
+        # qualify as a restore candidate
+        sup.observe(3, {"step_skipped": 0,
+                        "bucket_anomalies": np.zeros(3, np.int32)})
+        sup.note_checkpoint("/ck/ckpt-9.msgpack", 9)
+        st = sup.to_state()
+        fresh = Supervisor(num_buckets=3).load_state(st)
+        assert fresh.strikes == sup.strikes
+        assert fresh.forced_dense == [1]
+        assert fresh.last_good_step == sup.last_good_step
+        assert fresh.last_good_ckpt == "/ck/ckpt-9.msgpack"
+        assert fresh.fallback_events == 1
+
+    def test_plan_with_fallbacks(self):
+        assert plan_with_fallbacks(["oktopk", "gaussiank"], [1]) \
+            == ["oktopk", "dense"]
+        assert plan_with_fallbacks(["oktopk"], []) == ["oktopk"]
+
+
+class TestHealthJournal:
+    def test_schema_and_roundtrip(self, tmp_path):
+        from oktopk_tpu.autotune.journal import read_journal
+        path = str(tmp_path / "health.jsonl")
+        j = HealthJournal(path)
+        j.fault_seen(3, "planned", buckets=[0], counts=[2, 0])
+        j.guard_trip(3, [0], 1, [1, 0])
+        j.fallback(5, 0, "dense", 3)
+        j.restore(9, None, -1)
+        j.restore(11, "/ck/ckpt-8.msgpack", 8)
+        entries = read_journal(path)
+        assert [e["event"] for e in entries] == [
+            "header", "fault_seen", "guard_trip", "fallback",
+            "restore_unavailable", "restore"]
+        assert entries[0]["jax"] == jax.__version__
+        assert entries[2]["buckets"] == [0]
+        assert entries[3]["bucket"] == 0
+        assert entries[5]["ckpt"].endswith("ckpt-8.msgpack")
+
+
+# ---------------------------------------------------------------------------
+
+
+class TestTrainerRestore:
+    def test_supervise_restores_last_good_checkpoint(self, mesh4,
+                                                     tmp_path):
+        """Divergence-limit consecutive skips -> the trainer reloads the
+        checkpoint registered via note_checkpoint (driven with
+        fabricated guard metrics: the escalation path is host-side)."""
+        from oktopk_tpu.train.checkpoint import save_checkpoint
+
+        tr = _trainer(mesh4, resilience_divergence_limit=3)
+        path = save_checkpoint(str(tmp_path), tr.state, step=0,
+                               extra=tr.supervisor_extra())
+        tr.note_checkpoint(path, 0)
+        saved = jax.device_get(tr.state.params)
+        for b in _batches(2, seed=11):
+            tr.train_step(b)
+        assert not _leaves_equal(saved, tr.state.params)
+        skip = {"step_skipped": np.int32(1),
+                "bucket_anomalies": np.ones(1, np.int32)}
+        for step in (3, 4, 5):
+            tr.supervise(step, skip)
+        assert tr.supervisor.restore_events == 1
+        assert _leaves_equal(saved, tr.state.params)
