@@ -63,18 +63,28 @@ def volume_probe():
     state = batched_init_state(cfg)
     rng = np.random.RandomState(0)
     base = rng.randn(P, n).astype(np.float32)
-    vols = []
+    vols, wires = [], []
     for i in range(13):
         grads = jnp.asarray(base + 0.3 * rng.randn(P, n).astype(np.float32))
         _, state = step(grads, state)
         if i % 4 != 0:   # steady-state predicted steps
             vols.append(float(state.last_volume[0]))
+            wires.append(float(state.last_wire_bytes[0]))
+    from oktopk_tpu.obs.volume import budget_bytes
+    budget = budget_bytes("oktopk", cfg)
+    mean_wire = sum(wires) / len(wires)
     out = {"n": n, "k": cfg.k, "mean_volume_elems": sum(vols) / len(vols),
            "dense_volume_elems": 2.0 * n,
            # bytes per transmitted (index, value) pair: int32 index + the
            # configured wire value dtype (bf16 wire = 6, f32 wire = 8)
            "wire_pair_bytes": cfg.wire_pair_bytes,
-           "wire_dtype": cfg.wire_dtype}
+           "wire_dtype": cfg.wire_dtype,
+           # realised bytes on the wire (SparseState accounting) vs the
+           # paper's 6k-scalar analytic budget (obs/volume.py): <= 1.0
+           # means the O(k) volume claim held on the wire
+           "wire_bytes": mean_wire,
+           "volume_budget_bytes": budget,
+           "conformance_ratio": mean_wire / budget}
     print("VOLUME_PROBE " + json.dumps(out))
 
 
@@ -362,6 +372,12 @@ def main():
             "volume_elems": round(probe["mean_volume_elems"], 1),
             "wire_dtype": probe.get("wire_dtype", "float32"),
         }
+        # measured-on-the-wire conformance (obs/volume.py): present when
+        # the probe ran a build that threads wire-byte accounting
+        for key in ("wire_bytes", "volume_budget_bytes",
+                    "conformance_ratio"):
+            if key in probe:
+                rec[key] = round(float(probe[key]), 3)
         for key in ("device", "oktopk_ms", "oktopk_ms_std", "dense_ms",
                     "dense_ms_std", "dense_bs256_ms", "dense_bs256_ms_std",
                     "oktopk_bs256_ms", "oktopk_bs256_ms_std",

@@ -38,6 +38,13 @@ import json
 import os
 from typing import Any, Dict, List, Optional
 
+from oktopk_tpu.obs.events import SCHEMA_VERSION
+
+# standalone journal event name -> unified-bus event name. The file
+# view keeps its historical "decision" name; the bus renames it so a
+# consumer of the unified run journal can tell the streams apart.
+_BUS_EVENT_REMAP = {"decision": "autotune_decision"}
+
 
 def environment_header() -> Dict[str, Any]:
     """The jax/jaxlib/device/world identification every journal leads
@@ -45,7 +52,8 @@ def environment_header() -> Dict[str, Any]:
     be the reason a journal cannot be written)."""
     import jax
 
-    hdr: Dict[str, Any] = {"jax": jax.__version__}
+    hdr: Dict[str, Any] = {"jax": jax.__version__,
+                           "schema_version": SCHEMA_VERSION}
     try:
         import jaxlib
         hdr["jaxlib"] = getattr(jaxlib, "__version__", None)
@@ -65,10 +73,18 @@ def environment_header() -> Dict[str, Any]:
 class DecisionJournal:
     """Append-only JSONL writer. ``path=None`` keeps entries in memory only
     (tests, or callers that just want the plan). ``header=True`` writes
-    the :func:`environment_header` as the first record."""
+    the :func:`environment_header` as the first record.
 
-    def __init__(self, path: Optional[str] = None, header: bool = True):
+    With ``bus=`` (an ``obs.journal.EventBus``) every recorded event is
+    ALSO forwarded onto the unified run journal's bus — except the
+    header, which belongs to this standalone file only (the run journal
+    writes exactly one header of its own) — making this file a thin
+    view of the unified stream."""
+
+    def __init__(self, path: Optional[str] = None, header: bool = True,
+                 bus=None):
         self.path = path
+        self.bus = bus
         self.entries: List[Dict[str, Any]] = []
         if path:
             d = os.path.dirname(os.path.abspath(path))
@@ -85,6 +101,8 @@ class DecisionJournal:
         if self.path:
             with open(self.path, "a") as f:
                 f.write(json.dumps(entry) + "\n")
+        if self.bus is not None and event != "header":
+            self.bus.emit(_BUS_EVENT_REMAP.get(event, event), **fields)
         return entry
 
 

@@ -13,6 +13,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from oktopk_tpu.collectives.state import SparseState, bump
+from oktopk_tpu.collectives.wire import dense_wire_bytes
 from oktopk_tpu.comm.primitives import pvary_like
 from oktopk_tpu.config import OkTopkConfig
 
@@ -23,6 +24,7 @@ def dense_allreduce(grad: jnp.ndarray, state: SparseState, cfg: OkTopkConfig,
     out = lax.pmean(grad, axis_name)
     out, state = pvary_like(
         (out, bump(state, volume=2.0 * cfg.n,
+                   wire_bytes=dense_wire_bytes(2.0 * cfg.n),
                    local_count=cfg.n, global_count=cfg.n)), grad)
     return out, state
 

@@ -21,7 +21,11 @@ from oktopk_tpu.comm import all_gather, psum
 from oktopk_tpu.config import OkTopkConfig
 from oktopk_tpu.ops import gaussian_threshold, scatter_sparse, select_by_threshold
 from oktopk_tpu.ops.residual import add_residual
-from oktopk_tpu.collectives.wire import on_wire, residual_after_selection
+from oktopk_tpu.collectives.wire import (
+    on_wire,
+    pair_wire_bytes,
+    residual_after_selection,
+)
 
 
 def gaussian_k(grad: jnp.ndarray, state: SparseState, cfg: OkTopkConfig,
@@ -41,6 +45,8 @@ def gaussian_k(grad: jnp.ndarray, state: SparseState, cfg: OkTopkConfig,
     result = scatter_sparse(n, gv, gi) / P
 
     total = psum(count, axis_name)
-    return result, bump(state, volume=2.0 * total, residual=residual,
+    return result, bump(state, volume=2.0 * total,
+                        wire_bytes=pair_wire_bytes(total, cfg),
+                        residual=residual,
                         local_threshold=t,
                         local_count=count, global_count=total)

@@ -27,6 +27,7 @@ from oktopk_tpu.ops import exact_topk, scatter_sparse
 from oktopk_tpu.ops.residual import add_residual
 from oktopk_tpu.collectives.wire import (
     on_wire,
+    pair_wire_bytes,
     residual_after_selection,
     wire_round,
 )
@@ -73,5 +74,7 @@ def gtopk(grad: jnp.ndarray, state: SparseState, cfg: OkTopkConfig,
 
     result = scatter_sparse(n, vals, idx) / P
     vol = 4.0 * k * rounds
-    return result, bump(state, volume=vol, residual=residual,
+    return result, bump(state, volume=vol,
+                        wire_bytes=pair_wire_bytes(2.0 * k * rounds, cfg),
+                        residual=residual,
                         local_count=k, global_count=k)

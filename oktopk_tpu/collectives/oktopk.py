@@ -47,6 +47,7 @@ from oktopk_tpu.ops.topk import k2threshold_method
 from oktopk_tpu.ops.residual import add_residual
 from oktopk_tpu.collectives.wire import (
     on_wire as _on_wire,
+    pair_wire_bytes,
     residual_after_winners,
 )
 
@@ -298,7 +299,13 @@ def oktopk(grad: jnp.ndarray, state: SparseState, cfg: OkTopkConfig,
     winner_mask = result != 0.0
     residual = residual_after_winners(acc, winner_mask, mask, reduced, cfg)
 
-    return result, bump(state, volume=vol_a + vol_b, residual=residual,
+    # Both phases move (index, value) pairs and count volume as scalars
+    # (2 per pair), so the realised wire bytes follow from the same
+    # counts — the measured side of the paper's 6k-scalar budget.
+    wb = pair_wire_bytes(0.5 * (vol_a + vol_b), cfg)
+
+    return result, bump(state, volume=vol_a + vol_b, wire_bytes=wb,
+                        residual=residual,
                         local_threshold=lt_next, global_threshold=gt_next,
                         boundaries=boundaries, drift=drift,
                         last_exact_lt=last_exact_lt,

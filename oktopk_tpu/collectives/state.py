@@ -40,6 +40,15 @@ class SparseState:
     # Analytic comm-volume accounting (elements sent by this worker):
     volume_elems: jnp.ndarray         # f32 — cumulative over all steps
     last_volume: jnp.ndarray          # f32 — last step only
+    # Wire-level byte accounting (obs/volume.py): realised payload bytes
+    # crossing the collectives for this worker, wire-dtype-aware (bf16
+    # pairs are 6 bytes, f32 pairs 8, dense psum values 4). Unlike
+    # volume_elems — scalars in the paper's counting — these are the
+    # bytes the conformance checker holds against each algorithm's
+    # analytic budget. Threaded as traced values so lax.cond branches
+    # (dense fallbacks, exact recomputes) account what actually ran.
+    wire_bytes: jnp.ndarray           # f32 — cumulative over all steps
+    last_wire_bytes: jnp.ndarray      # f32 — last step only
     # realised selected counts (observability; reference logs these under
     # settings.PROFILING, VGG/allreducer.py:702-703)
     last_local_count: jnp.ndarray     # i32
@@ -66,19 +75,27 @@ def init_state(cfg: OkTopkConfig, dtype=jnp.float32) -> SparseState:
         residual=jnp.zeros((n,), dtype),
         volume_elems=jnp.asarray(0.0, jnp.float32),
         last_volume=jnp.asarray(0.0, jnp.float32),
+        wire_bytes=jnp.asarray(0.0, jnp.float32),
+        last_wire_bytes=jnp.asarray(0.0, jnp.float32),
         last_local_count=jnp.asarray(0, jnp.int32),
         last_global_count=jnp.asarray(0, jnp.int32),
     )
 
 
-def bump(state: SparseState, *, volume, local_count=None,
+def bump(state: SparseState, *, volume, wire_bytes=None, local_count=None,
          global_count=None, **updates) -> SparseState:
-    """Advance the step counter and record per-step accounting."""
+    """Advance the step counter and record per-step accounting.
+
+    ``wire_bytes`` is the step's realised wire-level byte count (None —
+    external callers predating the counter — records 0 for the step)."""
     vol = jnp.asarray(volume, jnp.float32)
+    wb = jnp.asarray(0.0 if wire_bytes is None else wire_bytes, jnp.float32)
     kw = dict(
         step=state.step + 1,
         volume_elems=state.volume_elems + vol,
         last_volume=vol,
+        wire_bytes=state.wire_bytes + wb,
+        last_wire_bytes=wb,
     )
     if local_count is not None:
         kw["last_local_count"] = jnp.asarray(local_count, jnp.int32)

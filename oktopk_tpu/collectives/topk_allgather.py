@@ -25,7 +25,11 @@ from oktopk_tpu.ops import (
 )
 from oktopk_tpu.ops.topk import k2threshold_method
 from oktopk_tpu.ops.residual import add_residual
-from oktopk_tpu.collectives.wire import on_wire, residual_after_selection
+from oktopk_tpu.collectives.wire import (
+    on_wire,
+    pair_wire_bytes,
+    residual_after_selection,
+)
 
 
 def _adapt_threshold(thresh, count, k, cfg: OkTopkConfig):
@@ -53,7 +57,9 @@ def topk_a(grad: jnp.ndarray, state: SparseState, cfg: OkTopkConfig,
     result = scatter_sparse(n, gv, gi) / P
 
     vol = 2.0 * k + 2.0 * k * (P - 1)         # send + receive, idx+val scalars
-    return result, bump(state, volume=vol, residual=residual,
+    return result, bump(state, volume=vol,
+                        wire_bytes=pair_wire_bytes(1.0 * k * P, cfg),
+                        residual=residual,
                         local_count=k, global_count=k * P)
 
 
@@ -98,6 +104,8 @@ def topk_a_opt(grad: jnp.ndarray, state: SparseState, cfg: OkTopkConfig,
     total = psum(count, axis_name)
     lt_next = _adapt_threshold(lt, count, k, cfg)
     vol = 2.0 * total                          # sent 2c + received 2(total-c)
-    return result, bump(state, volume=vol, residual=residual,
+    return result, bump(state, volume=vol,
+                        wire_bytes=pair_wire_bytes(total, cfg),
+                        residual=residual,
                         local_threshold=lt_next,
                         local_count=count, global_count=total)

@@ -31,7 +31,12 @@ from oktopk_tpu.ops import (
 from oktopk_tpu.ops.select import select_nonzero
 from oktopk_tpu.ops.topk import k2threshold_method
 from oktopk_tpu.ops.residual import add_residual
-from oktopk_tpu.collectives.wire import on_wire, residual_after_winners
+from oktopk_tpu.collectives.wire import (
+    dense_wire_bytes,
+    on_wire,
+    pair_wire_bytes,
+    residual_after_winners,
+)
 
 
 def _split_allreduce(acc, lt, state: SparseState, cfg: OkTopkConfig,
@@ -69,30 +74,33 @@ def _split_allreduce(acc, lt, state: SparseState, cfg: OkTopkConfig,
         result = scatter_sparse(n, gv, gi)
         total = psum(gcount, axis_name)
         vol = 2.0 * gcount + 2.0 * (total - gcount)
-        return pvary_like((result, vol, jnp.float32(1.0)), acc)
+        return pvary_like((result, vol, pair_wire_bytes(total, cfg),
+                           jnp.float32(1.0)), acc)
 
     def dense_gather():
         # Regions are disjoint, so psum of the partials is the dense gather
         # the reference falls back to (VGG/allreducer.py:1318-1351). The
         # psum is NOT wire-rounded, so the owner's gather-rounding
-        # compensation must be off (third element 0.0).
+        # compensation must be off (last element 0.0) — and its wire bytes
+        # are bare f32 values (no indices), not sparse pairs.
         return pvary_like(
             (psum(reduced, axis_name), jnp.asarray(2.0 * n, jnp.float32),
-             jnp.float32(0.0)),
+             dense_wire_bytes(2.0 * n), jnp.float32(0.0)),
             acc)
 
     if dense_fallback:
-        result, vol_b, gather_rounded = lax.cond(
+        result, vol_b, wb_b, gather_rounded = lax.cond(
             total_nnz >= cfg.sa_dense_fallback_ratio * n,
             dense_gather, sparse_gather)
     else:
-        result, vol_b, gather_rounded = sparse_gather()
+        result, vol_b, wb_b, gather_rounded = sparse_gather()
 
     result = result / P
     winner_mask = result != 0.0
     residual = residual_after_winners(acc, winner_mask, mask, reduced, cfg,
                                       owner_scale=gather_rounded)
-    return result, residual, vol_a + vol_b, local_count, total_nnz
+    wb = pair_wire_bytes(0.5 * vol_a, cfg) + wb_b
+    return result, residual, vol_a + vol_b, wb, local_count, total_nnz
 
 
 def topk_sa(grad: jnp.ndarray, state: SparseState, cfg: OkTopkConfig,
@@ -109,13 +117,13 @@ def topk_sa(grad: jnp.ndarray, state: SparseState, cfg: OkTopkConfig,
                       abs_acc, k, cfg.threshold_method,
                       cfg.bisect_iters).astype(acc.dtype),
                   lambda: state.local_threshold)
-    result, residual, vol, lc, gc = _split_allreduce(
+    result, residual, vol, wb, lc, gc = _split_allreduce(
         acc, lt, state, cfg, axis_name, dense_fallback=True)
     grow = lc > cfg.band_hi * k
     shrink = lc < cfg.band_lo * k
     lt_next = lt * jnp.where(grow, cfg.local_adapt_scale,
                              jnp.where(shrink, 1.0 / cfg.local_adapt_scale, 1.0))
-    return result, bump(state, volume=vol, residual=residual,
+    return result, bump(state, volume=vol, wire_bytes=wb, residual=residual,
                         local_threshold=lt_next,
                         local_count=lc, global_count=gc)
 
@@ -126,8 +134,8 @@ def gaussian_k_sa(grad: jnp.ndarray, state: SparseState, cfg: OkTopkConfig,
     (reference VGG/allreducer.py:1503-1620)."""
     acc = add_residual(grad, state.residual)
     t = gaussian_threshold(acc, cfg.k, cfg.gaussian_refine_iters).astype(acc.dtype)
-    result, residual, vol, lc, gc = _split_allreduce(
+    result, residual, vol, wb, lc, gc = _split_allreduce(
         acc, t, state, cfg, axis_name, dense_fallback=False)
-    return result, bump(state, volume=vol, residual=residual,
+    return result, bump(state, volume=vol, wire_bytes=wb, residual=residual,
                         local_threshold=t,
                         local_count=lc, global_count=gc)

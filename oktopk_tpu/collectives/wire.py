@@ -65,6 +65,22 @@ def on_wire(x, cfg: OkTopkConfig, step=None):
     return x
 
 
+def pair_wire_bytes(pairs, cfg: OkTopkConfig):
+    """Bytes for ``pairs`` transmitted (index, value) pairs under the
+    configured wire format: int32 index (4 B) + bf16/f32 value (2/4 B).
+    ``pairs`` may be traced (realised counts from inside the step); the
+    result feeds ``state.wire_bytes`` via ``bump`` (obs/volume.py checks
+    it against each algorithm's analytic budget)."""
+    return jnp.asarray(pairs, jnp.float32) * float(cfg.wire_pair_bytes)
+
+
+def dense_wire_bytes(values, value_bytes: int = 4):
+    """Bytes for ``values`` transmitted bare value scalars — the dense
+    psum/pmean paths, which carry no indices and are NOT wire-rounded
+    (always f32 unless stated otherwise)."""
+    return jnp.asarray(values, jnp.float32) * float(value_bytes)
+
+
 def wire_round(x, cfg: OkTopkConfig):
     """Round ``x`` through the wire dtype (identity for float32).
 

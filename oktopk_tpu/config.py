@@ -364,6 +364,30 @@ class TrainConfig:
     # JSONL health-journal path; None keeps the journal in memory.
     resilience_journal: Optional[str] = None
 
+    # ---- unified observability (obs/) ---------------------------------
+    # When True the trainer runs an event bus + run journal: per-step
+    # metrics, autotune decisions, guard trips, fallbacks, checkpoints,
+    # trace captures and end-of-run volume reports all land in ONE
+    # JSONL file behind one environment header (obs/journal.py).
+    obs: bool = False
+    # Run-journal path; None keeps the journal in memory only.
+    obs_journal: Optional[str] = None
+    # Arm a bounded jax.profiler trace window on guard_trip/fallback
+    # events (obs/tracing.py AnomalyTracer).
+    obs_trace_on_anomaly: bool = False
+    # Steps per anomaly-triggered trace window.
+    obs_trace_steps: int = 3
+    # Directory for anomaly trace captures; None derives from the
+    # journal path (or a temp dir when the journal is in-memory).
+    obs_trace_dir: Optional[str] = None
+    # Max anomaly windows per run (a flapping guard must not fill disk).
+    obs_max_traces: int = 3
+    # BENCH_r*.json parsed key to build the step-time regression
+    # baseline from (obs/regress.py); None disables regression checks.
+    obs_regress_key: Optional[str] = None
+    # Step time above tolerance x baseline journals a regression event.
+    obs_regress_tolerance: float = 1.5
+
     def experiment_slug(self) -> str:
         """Reference experiment naming convention
         (VGG/main_trainer.py:163-166)."""

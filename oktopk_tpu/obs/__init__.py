@@ -1,0 +1,21 @@
+"""Unified observability layer: typed run-journal events, wire-level
+volume conformance, and anomaly-triggered tracing.
+
+Deliberately import-free: ``autotune/journal.py`` imports
+``obs.events`` (for the schema version) while ``obs.journal`` imports
+``autotune/journal.py`` (for the environment header and JSONL reader).
+Importing either submodule here would close that loop into a cycle, so
+callers import the submodules directly:
+
+  - :mod:`oktopk_tpu.obs.events`  — schema-versioned event definitions +
+    validation (no oktopk imports at all).
+  - :mod:`oktopk_tpu.obs.journal` — :class:`EventBus` and
+    :class:`RunJournal` (the single per-run JSONL sink).
+  - :mod:`oktopk_tpu.obs.volume`  — per-algorithm analytic wire-byte
+    budgets and conformance ratios.
+  - :mod:`oktopk_tpu.obs.tracing` — :class:`AnomalyTracer` (bounded
+    ``jax.profiler`` windows armed by guard trips) and
+    :class:`ChromeTraceSink` (host-phase Chrome trace export).
+  - :mod:`oktopk_tpu.obs.regress` — step-time regression detection
+    against the repo's BENCH_r*.json trajectory.
+"""

@@ -1,0 +1,184 @@
+"""Typed, schema-versioned run-journal events.
+
+The run journal (obs/journal.py) is one JSONL file per training run that
+carries every observability stream — per-step metrics, autotune
+decisions, guard trips, dense fallbacks, checkpoints, captured traces,
+volume conformance — behind ONE environment header, so a single ``grep``
+or ``read_journal`` reconstructs the whole incident timeline.
+
+This module is the schema authority and imports nothing from the rest of
+the package (``autotune/journal.py`` imports it for ``SCHEMA_VERSION``,
+so any oktopk import here would be a cycle).
+
+Validation is deliberately permissive about EXTRA fields — emitters may
+attach context freely — and strict about required fields and their
+types: a journal that validates here is guaranteed to render in
+``scripts/obs_report.py`` and to be parseable by the regression and
+conformance tooling.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List
+
+SCHEMA_VERSION = 1
+
+_NUM = (int, float)
+_STR = (str,)
+_OPT_STR = (str, type(None))
+_BOOL = (bool,)
+_LIST = (list,)
+_DICT = (dict,)
+_OPT_LIST = (list, type(None))
+_OPT_DICT = (dict, type(None))
+
+# event -> {"required": {field: allowed types},
+#           "optional": {field: allowed types}}
+# Unknown extra fields are always allowed; required fields must be
+# present AND type-check; optional fields type-check when present.
+EVENT_SCHEMAS: Dict[str, Dict[str, Dict[str, tuple]]] = {
+    # one per journal, always first (autotune/journal.py
+    # environment_header + schema_version)
+    "header": {
+        "required": {"jax": _OPT_STR},
+        "optional": {"jaxlib": _OPT_STR, "device_kind": _OPT_STR,
+                     "platform": _OPT_STR, "world_size": _NUM,
+                     "schema_version": _NUM},
+    },
+    # per-step training metrics (trainer.py flush cadence; host-side
+    # floats, already device-meaned)
+    "step": {
+        "required": {"step": _NUM},
+        "optional": {"loss": _NUM, "grad_norm": _NUM,
+                     "grad_nonfinite": _NUM, "comm_volume": _NUM,
+                     "wire_bytes": _NUM, "local_k": _NUM,
+                     "global_k": _NUM, "eps_vs_dense": _NUM,
+                     "step_skipped": _NUM, "steps_skipped": _NUM,
+                     "bucket_anomalies": _NUM, "dt_ms": _NUM},
+    },
+    # autotuner fabric calibration (autotune/policy.py)
+    "calibration": {
+        "required": {"step": _NUM},
+        "optional": {"num_workers": _NUM, "alpha": _NUM, "beta": _NUM,
+                     "sizes": _LIST, "times_ms": _LIST,
+                     "residual": _NUM, "source": _STR},
+    },
+    # per-bucket autotune decision. "decision" is the event name the
+    # standalone DecisionJournal file keeps (pre-obs compatibility);
+    # "autotune_decision" is the same payload on the unified bus
+    # (journal.py _BUS_EVENT_REMAP).
+    "decision": {
+        "required": {"step": _NUM, "bucket": _NUM, "chosen": _DICT,
+                     "reason": _STR},
+        "optional": {"n": _NUM, "num_workers": _NUM,
+                     "candidates": _LIST, "incumbent": _OPT_DICT},
+    },
+    "autotune_decision": {
+        "required": {"step": _NUM, "bucket": _NUM, "chosen": _DICT,
+                     "reason": _STR},
+        "optional": {"n": _NUM, "num_workers": _NUM,
+                     "candidates": _LIST, "incumbent": _OPT_DICT},
+    },
+    # resilience events (resilience/journal.py HealthJournal)
+    "guard_trip": {
+        "required": {"step": _NUM, "buckets": _LIST,
+                     "consecutive_skips": _NUM, "strikes": _LIST},
+        "optional": {},
+    },
+    "fault_seen": {
+        "required": {"step": _NUM, "kind": _STR},
+        "optional": {"buckets": _LIST, "counts": _OPT_LIST},
+    },
+    "fallback": {
+        "required": {"step": _NUM, "bucket": _NUM, "algo": _STR,
+                     "strikes": _NUM},
+        "optional": {},
+    },
+    "restore": {
+        "required": {"step": _NUM, "ckpt": _STR,
+                     "last_good_step": _NUM},
+        "optional": {},
+    },
+    "restore_unavailable": {
+        "required": {"step": _NUM, "last_good_step": _NUM},
+        "optional": {},
+    },
+    # checkpoint written (resilience/supervisor.py note_checkpoint;
+    # qualified=False means skips were in flight so it is NOT a
+    # restore target)
+    "checkpoint": {
+        "required": {"step": _NUM, "path": _STR, "qualified": _BOOL},
+        "optional": {},
+    },
+    # bounded profiler window closed (obs/tracing.py AnomalyTracer)
+    "trace_captured": {
+        "required": {"step": _NUM, "start_step": _NUM,
+                     "num_steps": _NUM, "trigger": _STR},
+        "optional": {"logdir": _OPT_STR},
+    },
+    # end-of-run per-bucket wire-volume conformance (trainer.py +
+    # obs/volume.py)
+    "volume_report": {
+        "required": {"step": _NUM, "bucket": _NUM, "algo": _STR},
+        "optional": {"n": _NUM, "density": _NUM, "steps": _NUM,
+                     "wire_bytes": _NUM, "mean_wire_bytes": _NUM,
+                     "budget_bytes": _NUM, "capacity_bytes": _NUM,
+                     "conformance_ratio": _NUM},
+    },
+    # host phase-timer snapshot (utils/profiling.py PhaseTimers.summary)
+    "phase": {
+        "required": {"step": _NUM},
+        "optional": {"phases": _DICT},
+    },
+    # step-time regression vs the BENCH trajectory (obs/regress.py)
+    "regression": {
+        "required": {"step": _NUM, "ms": _NUM, "baseline_ms": _NUM,
+                     "ratio": _NUM},
+        "optional": {"key": _OPT_STR, "tolerance": _NUM},
+    },
+}
+
+
+def validate_event(entry: Any) -> List[str]:
+    """Problems with one journal entry (empty list = valid)."""
+    problems: List[str] = []
+    if not isinstance(entry, dict):
+        return [f"entry is {type(entry).__name__}, not dict"]
+    event = entry.get("event")
+    if not isinstance(event, str):
+        return ["missing or non-string 'event' field"]
+    schema = EVENT_SCHEMAS.get(event)
+    if schema is None:
+        return [f"unknown event {event!r} (schema v{SCHEMA_VERSION})"]
+    for field, types in schema["required"].items():
+        if field not in entry:
+            problems.append(f"{event}: missing required field {field!r}")
+        elif not isinstance(entry[field], types):
+            problems.append(
+                f"{event}: field {field!r} is "
+                f"{type(entry[field]).__name__}, expected one of "
+                f"{tuple(t.__name__ for t in types)}")
+    for field, types in schema["optional"].items():
+        if field in entry and not isinstance(entry[field], types):
+            problems.append(
+                f"{event}: field {field!r} is "
+                f"{type(entry[field]).__name__}, expected one of "
+                f"{tuple(t.__name__ for t in types)}")
+    return problems
+
+
+def validate_journal(entries: List[Dict[str, Any]]) -> List[str]:
+    """Problems with a whole journal: exactly one header, first, and
+    every entry valid. Empty list = conformant."""
+    problems: List[str] = []
+    if not entries:
+        return ["journal is empty"]
+    if entries[0].get("event") != "header":
+        problems.append("first entry is not an environment header")
+    n_headers = sum(1 for e in entries
+                    if isinstance(e, dict) and e.get("event") == "header")
+    if n_headers != 1:
+        problems.append(f"expected exactly 1 header, found {n_headers}")
+    for i, entry in enumerate(entries):
+        problems.extend(f"entry {i}: {p}" for p in validate_event(entry))
+    return problems

@@ -1,0 +1,87 @@
+"""Step-time regression detection against the BENCH trajectory.
+
+The repo root accumulates ``BENCH_r*.json`` records — one per growth
+round, each with a ``parsed`` dict of per-algorithm millisecond
+timings (e.g. ``{"oktopk_ms": 177.6, "dense_ms": 67.3, ...}``). Their
+median is a cheap, already-maintained baseline for "how fast should a
+step be on this container", so a live run can flag when its own step
+time drifts past ``tolerance ×`` that history and journal a
+``regression`` event the report surfaces.
+
+The detector is advisory: it never throws, and with no baseline
+available (no records, or none carrying the key) it stays silent.
+A warmup window skips the first observations — compile time dominates
+them and would always "regress".
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import statistics
+from typing import Any, Dict, List, Optional
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def load_bench_values(key: str,
+                      root: Optional[str] = None) -> List[float]:
+    """All ``parsed[key]`` values from BENCH_r*.json under ``root``
+    (repo root by default). Tolerates missing/garbled records."""
+    root = root or _REPO_ROOT
+    out: List[float] = []
+    for path in sorted(glob.glob(os.path.join(root, "BENCH_r*.json"))):
+        try:
+            with open(path) as f:
+                rec = json.load(f)
+            val = (rec.get("parsed") or {}).get(key)
+            if isinstance(val, (int, float)):
+                out.append(float(val))
+        except Exception:
+            continue
+    return out
+
+
+class RegressionDetector:
+    """Flags step times above ``tolerance × baseline_ms``."""
+
+    def __init__(self, baseline_ms: Optional[float],
+                 tolerance: float = 1.5, warmup_windows: int = 2,
+                 bus=None, key: Optional[str] = None):
+        self.baseline_ms = baseline_ms
+        self.tolerance = float(tolerance)
+        self.warmup_windows = int(warmup_windows)
+        self.bus = bus
+        self.key = key
+        self.observations = 0
+        self.flagged: List[Dict[str, Any]] = []
+
+    @classmethod
+    def from_bench_records(cls, key: str = "oktopk_ms",
+                           root: Optional[str] = None,
+                           **kwargs) -> "RegressionDetector":
+        vals = load_bench_values(key, root=root)
+        baseline = statistics.median(vals) if vals else None
+        return cls(baseline, key=key, **kwargs)
+
+    def observe(self, step: int, ms: float) -> Optional[Dict[str, Any]]:
+        """Feed one measured step time (milliseconds). Returns the
+        regression record when flagged, else None."""
+        self.observations += 1
+        if self.baseline_ms is None or self.baseline_ms <= 0:
+            return None
+        if self.observations <= self.warmup_windows:
+            return None
+        ms = float(ms)
+        if ms <= self.tolerance * self.baseline_ms:
+            return None
+        rec = {"step": int(step), "ms": ms,
+               "baseline_ms": float(self.baseline_ms),
+               "ratio": ms / self.baseline_ms,
+               "tolerance": self.tolerance, "key": self.key}
+        self.flagged.append(rec)
+        if self.bus is not None:
+            self.bus.emit("regression", **rec)
+        return rec

@@ -1,0 +1,129 @@
+"""Anomaly-triggered profiler windows + Chrome trace export.
+
+``AnomalyTracer`` subscribes to the run-journal event bus: a
+``guard_trip`` or ``fallback`` event ARMS it, and the next
+``on_step()`` call opens a bounded ``jax.profiler`` trace window over
+the following N steps, closing with a ``trace_captured`` journal event
+that ties the capture back to its trigger (``"guard_trip@step12"``).
+The expensive instrument therefore runs only when something is already
+wrong — the steady-state overhead is one predicate per step.
+
+Capture count is capped (``max_captures``): a flapping guard must not
+fill the disk with traces. Profiler failures are tolerated — the
+window is journalled with ``logdir: null`` rather than raising, since
+observability must never take down training (some backends/platforms
+cannot start a trace at all).
+
+``ChromeTraceSink`` collects host-phase samples (utils/profiling.py
+``PhaseTimers``) as Chrome trace-event ``"X"`` (complete) events for
+``chrome://tracing`` / Perfetto.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, List, Optional
+
+_TRIGGERS = ("guard_trip", "fallback")
+
+
+class AnomalyTracer:
+    """Arms on anomaly events, captures a bounded trace window."""
+
+    def __init__(self, logdir: str, bus=None, num_steps: int = 3,
+                 max_captures: int = 3):
+        self.logdir = logdir
+        self.bus = bus
+        self.num_steps = max(1, int(num_steps))
+        self.max_captures = max(0, int(max_captures))
+        self.captures: List[Dict[str, Any]] = []
+        self._armed: Optional[str] = None      # trigger description
+        self._start_step: Optional[int] = None
+        self._active_dir: Optional[str] = None
+        self._profiler_ok = False
+        if bus is not None:
+            bus.subscribe(self._on_event)
+
+    @property
+    def active(self) -> bool:
+        return self._start_step is not None
+
+    def _on_event(self, entry: Dict[str, Any]):
+        event = entry.get("event")
+        if event not in _TRIGGERS:
+            return
+        if self.active or self._armed is not None:
+            return                 # one window at a time
+        if len(self.captures) >= self.max_captures:
+            return
+        self._armed = f"{event}@step{entry.get('step')}"
+
+    def on_step(self, step: int):
+        """Call once per training step (host side, before the step)."""
+        step = int(step)
+        if self.active:
+            if step >= self._start_step + self.num_steps:
+                self._stop(step)
+            return
+        if self._armed is not None:
+            self._start(step)
+
+    def _start(self, step: int):
+        d = os.path.join(self.logdir, f"anomaly_step{step}")
+        self._profiler_ok = False
+        try:
+            import jax
+            os.makedirs(d, exist_ok=True)
+            jax.profiler.start_trace(d)
+            self._profiler_ok = True
+            self._active_dir = d
+        except Exception:
+            self._active_dir = None   # journal the window anyway
+        self._start_step = step
+
+    def _stop(self, step: int):
+        if self._profiler_ok:
+            try:
+                import jax
+                jax.profiler.stop_trace()
+            except Exception:
+                self._active_dir = None
+        cap = {"step": int(step), "start_step": int(self._start_step),
+               "num_steps": int(step - self._start_step),
+               "logdir": self._active_dir,
+               "trigger": self._armed or "unknown"}
+        self.captures.append(cap)
+        self._armed = None
+        self._start_step = None
+        self._active_dir = None
+        self._profiler_ok = False
+        if self.bus is not None:
+            self.bus.emit("trace_captured", **cap)
+
+    def finish(self, step: int):
+        """Force-close any open window (end of train())."""
+        if self.active:
+            self._stop(int(step))
+
+
+class ChromeTraceSink:
+    """Collects host phase samples as Chrome trace-event JSON."""
+
+    def __init__(self):
+        self.events: List[Dict[str, Any]] = []
+
+    def add(self, name: str, ts_s: float, dur_s: float):
+        """One complete ("X") event; times in seconds (host clock)."""
+        self.events.append({
+            "name": name, "ph": "X", "pid": 0, "tid": 0,
+            "ts": float(ts_s) * 1e6, "dur": float(dur_s) * 1e6,
+        })
+
+    def write(self, path: str) -> str:
+        d = os.path.dirname(os.path.abspath(path))
+        os.makedirs(d, exist_ok=True)
+        with open(path, "w") as f:
+            json.dump({"traceEvents": self.events,
+                       "displayTimeUnit": "ms"}, f)
+        return path

@@ -1,0 +1,134 @@
+"""Per-algorithm analytic wire-byte budgets and conformance ratios.
+
+The collectives now thread REALISED payload bytes through
+``SparseState.wire_bytes`` (collectives/state.py, wire-dtype-aware:
+bf16 pairs are 6 bytes, f32 pairs 8, dense psum values 4 — see
+``collectives/wire.py`` pair_wire_bytes/dense_wire_bytes). This module
+supplies the analytic side: what each algorithm is ALLOWED to move per
+worker per steady-state step, so ``conformance_ratio = measured /
+budget <= 1.0`` is a checkable invariant for all eight algorithms.
+
+Budget semantics differ by family, on purpose:
+
+- ``oktopk``: the paper's O(k) claim — 6k scalars = 3k (index, value)
+  pairs per step (Ok-Topk §4). This is a *paper-conformance* bound:
+  the measured steady-state traffic (prediction steps, not the
+  every-``global_recompute_every`` exact recomputes, which draw from
+  the larger ``cap_exact`` pool) must fit under it. Realised traffic
+  is ≈2.4k pairs, so the ratio lands near 0.8 with headroom that is
+  the algorithm's safety margin, not slack in the test.
+- ``topkA``/``topkA2``: exactly kP pairs — the allgather of [P, k]
+  buffers admits no variance, so the ratio is exactly 1.0.
+- ``gtopk``: 2k pairs per butterfly round × log2(P) rounds (tight).
+- ``topkAopt``/``gaussiank``/``gaussiankconcat``: P·cap_local pairs —
+  the fixed-capacity buffers' hard guarantee. Threshold selection can
+  overshoot k (Gaussian fit error, stale thresholds), so a k-based
+  band budget would flake; the capacity ceiling is the contract the
+  fixed buffers actually enforce (and which the reference's ragged
+  Allgatherv lacks).
+- ``topkSA``/``topkDSA``: split phase ≤ 2(P−1)·cap_pair pairs, plus a
+  gather phase that may densify — max(P·cap_local pairs, 2n f32
+  values) covers the dense fallback branch.
+- ``gaussiankSA``: same split phase + always-sparse gather.
+- ``dense``: 2n f32 values (ring-allreduce send+receive; the psum is
+  never wire-rounded).
+
+``capacity_bytes`` is the static buffer ceiling for every algorithm —
+the absolute worst case any step (including oktopk exact recomputes)
+can move — reported alongside the budget for context.
+"""
+
+from __future__ import annotations
+
+import math
+
+from oktopk_tpu.config import OkTopkConfig
+
+# registry aliases (collectives/registry.py): same function, same wire
+_ALIAS = {"gaussiankconcat": "gaussiank", "topkDSA": "topkSA"}
+
+
+def _canon(name: str) -> str:
+    return _ALIAS.get(name, name)
+
+
+def budget_bytes(name: str, cfg: OkTopkConfig) -> float:
+    """Per-worker steady-state wire-byte budget for one step of
+    algorithm ``name`` under ``cfg``. Measured ``last_wire_bytes`` must
+    satisfy ``measured <= budget`` (conformance ratio <= 1.0)."""
+    name = _canon(name)
+    P, n, k = cfg.num_workers, cfg.n, cfg.k
+    pair = float(cfg.wire_pair_bytes)
+    if name == "dense":
+        return 2.0 * n * 4.0
+    if name in ("topkA", "topkA2"):
+        return float(k) * P * pair
+    if name == "gtopk":
+        rounds = max(1, int(math.log2(P)))
+        return 2.0 * k * rounds * pair
+    if name == "oktopk":
+        return 3.0 * k * pair          # the paper's 6k scalars
+    if name in ("topkAopt", "gaussiank"):
+        return float(P) * cfg.cap_local * pair
+    if name == "topkSA":
+        split = 2.0 * (P - 1) * cfg.cap_pair * pair
+        gather = max(float(P) * cfg.cap_local * pair, 2.0 * n * 4.0)
+        return split + gather
+    if name == "gaussiankSA":
+        split = 2.0 * (P - 1) * cfg.cap_pair * pair
+        return split + float(P) * cfg.cap_local * pair
+    raise ValueError(f"no wire-byte budget for algorithm {name!r}")
+
+
+def capacity_bytes(name: str, cfg: OkTopkConfig) -> float:
+    """Static worst-case ceiling: the most any single step (including
+    oktopk's exact-recompute steps) can put on the wire per worker."""
+    name = _canon(name)
+    P, n, k = cfg.num_workers, cfg.n, cfg.k
+    pair = float(cfg.wire_pair_bytes)
+    if name == "dense":
+        return 2.0 * n * 4.0
+    if name in ("topkA", "topkA2"):
+        return float(k) * P * pair
+    if name == "gtopk":
+        rounds = max(1, int(math.log2(P)))
+        return 2.0 * k * rounds * pair
+    if name == "oktopk":
+        split = 2.0 * (P - 1) * cfg.cap_pair * pair
+        gather = float(P) * max(cfg.cap_gather, cfg.cap_exact) * pair
+        return split + gather
+    if name in ("topkAopt", "gaussiank"):
+        return float(P) * cfg.cap_local * pair
+    if name == "topkSA":
+        split = 2.0 * (P - 1) * cfg.cap_pair * pair
+        gather = max(float(P) * cfg.cap_local * pair, 2.0 * n * 4.0)
+        return split + gather
+    if name == "gaussiankSA":
+        split = 2.0 * (P - 1) * cfg.cap_pair * pair
+        return split + float(P) * cfg.cap_local * pair
+    raise ValueError(f"no wire-byte capacity for algorithm {name!r}")
+
+
+def conformance_ratio(name: str, cfg: OkTopkConfig,
+                      measured_bytes: float) -> float:
+    """measured / budget. <= 1.0 means the algorithm kept its analytic
+    volume promise on the wire."""
+    b = budget_bytes(name, cfg)
+    return float(measured_bytes) / b if b > 0 else float("inf")
+
+
+def volume_report(name: str, cfg: OkTopkConfig, mean_wire_bytes: float,
+                  *, bucket: int = 0, step: int = 0,
+                  steps: int = 0) -> dict:
+    """Assemble one ``volume_report`` event payload
+    (obs/events.py schema) from a measured per-step mean."""
+    return {
+        "step": int(step), "bucket": int(bucket), "algo": name,
+        "n": int(cfg.n), "density": float(cfg.density),
+        "steps": int(steps),
+        "mean_wire_bytes": float(mean_wire_bytes),
+        "budget_bytes": float(budget_bytes(name, cfg)),
+        "capacity_bytes": float(capacity_bytes(name, cfg)),
+        "conformance_ratio": conformance_ratio(name, cfg,
+                                               mean_wire_bytes),
+    }

@@ -269,7 +269,7 @@ def build_sparse_grad_step(
                    if momentum_correction else None)
         results = [None] * len(leaves)
         sp_olds, sp_news, new_moms, bad_counts = [], [], [], []
-        vol = lk = gk = jnp.asarray(0.0, jnp.float32)
+        vol = lk = gk = wbytes = jnp.asarray(0.0, jnp.float32)
         eps_num = eps_den = jnp.asarray(0.0, jnp.float32)
         for bi, idxs in enumerate(buckets):
             flat = jnp.concatenate([leaves[i].reshape(-1) for i in idxs])
@@ -304,6 +304,7 @@ def build_sparse_grad_step(
             sp_olds.append(sp)
             sp_news.append(sp_new)
             vol = vol + sp_new.last_volume
+            wbytes = wbytes + sp_new.last_wire_bytes
             lk = lk + sp_new.last_local_count
             gk = gk + sp_new.last_global_count
             if profile_norm:
@@ -333,6 +334,7 @@ def build_sparse_grad_step(
             "grad_norm": grad_norm,
             "grad_nonfinite": grad_nonfinite,
             "comm_volume": vol,
+            "wire_bytes": wbytes,
             "local_k": lk,
             "global_k": gk,
         }
@@ -362,6 +364,8 @@ def build_sparse_grad_step(
                     old.replace(step=new.step,
                                 volume_elems=new.volume_elems,
                                 last_volume=new.last_volume,
+                                wire_bytes=new.wire_bytes,
+                                last_wire_bytes=new.last_wire_bytes,
                                 last_local_count=new.last_local_count,
                                 last_global_count=new.last_global_count),
                     new)

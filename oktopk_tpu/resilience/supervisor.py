@@ -76,10 +76,15 @@ class Supervisor:
     def note_checkpoint(self, path: str, step: int) -> None:
         """Register a checkpoint as a restore candidate. Only checkpoints
         taken while the run is healthy qualify — restoring into a
-        snapshot saved mid-incident would replay the divergence."""
-        if self.consecutive_skips == 0:
+        snapshot saved mid-incident would replay the divergence. Every
+        checkpoint is journalled either way, with the ``qualified`` flag
+        saying whether it became a restore target."""
+        qualified = self.consecutive_skips == 0
+        if qualified:
             self.last_good_ckpt = path
             self.last_good_step = int(step)
+        self.journal.record("checkpoint", step=int(step), path=path,
+                            qualified=qualified)
 
     def observe(self, step: int, metrics: Dict[str, Any]) -> List[Action]:
         """Digest one step's guard metrics; return escalation actions.

@@ -74,6 +74,22 @@ def parse_args(argv=None):
                         "even while finite (wire bit-flips land ~1e38)")
     p.add_argument("--resilience-journal", default=None,
                    help="JSONL health-journal path (docs/RESILIENCE.md)")
+    p.add_argument("--obs", action="store_true",
+                   help="unified run journal (obs/): per-step metrics, "
+                        "autotune decisions, guard trips, checkpoints, "
+                        "trace captures and volume reports in ONE JSONL "
+                        "file (docs/OBSERVABILITY.md)")
+    p.add_argument("--obs-journal", default=None,
+                   help="run-journal path (default: "
+                        "<logdir>/<slug>/run_journal.jsonl)")
+    p.add_argument("--obs-trace-on-anomaly", action="store_true",
+                   help="arm a bounded jax.profiler window on guard_trip/"
+                        "fallback events (obs/tracing.py)")
+    p.add_argument("--obs-trace-steps", type=int, default=3,
+                   help="steps per anomaly-triggered trace window")
+    p.add_argument("--obs-regress-key", default=None,
+                   help="BENCH_r*.json parsed key (e.g. oktopk_ms) to "
+                        "baseline step-time regression checks against")
     p.add_argument("--density", type=float, default=0.02)
     p.add_argument("--sigma-scale", type=float, default=2.5)
     p.add_argument("--grad-clip", type=float, default=None)
@@ -149,12 +165,23 @@ def main(argv=None):
         resilience=args.resilience,
         resilience_strikes=args.resilience_strikes,
         resilience_abs_limit=args.resilience_abs_limit,
-        resilience_journal=args.resilience_journal)
+        resilience_journal=args.resilience_journal,
+        obs=args.obs,
+        obs_trace_on_anomaly=args.obs_trace_on_anomaly,
+        obs_trace_steps=args.obs_trace_steps,
+        obs_regress_key=args.obs_regress_key)
     slug = cfg.experiment_slug()
     # Observability and checkpoints are rank-0 work (the reference gates its
     # writer/checkpointer the same way, VGG/dl_trainer.py:614-616) — on a
     # shared filesystem every process writing the same paths corrupts them.
     is_rank0 = jax.process_index() == 0
+    if args.obs and is_rank0:
+        # non-rank-0 processes keep the bus with an in-memory journal
+        # (tracer arming still works) but never write the shared file
+        import dataclasses as _dc
+        cfg = _dc.replace(
+            cfg, obs_journal=(args.obs_journal or os.path.join(
+                args.logdir, slug, "run_journal.jsonl")))
     logger = get_logger(
         "oktopk_tpu",
         os.path.join(args.logdir, slug, f"rank{jax.process_index()}.log"))

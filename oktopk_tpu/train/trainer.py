@@ -118,6 +118,36 @@ class Trainer:
         self._warmup = warmup
         self._profile_norm = profile_norm
 
+        # ---- unified observability (obs/): event bus + run journal ----
+        # Built BEFORE the resilience/autotune journals so both can be
+        # constructed as thin views over the same bus.
+        self.bus = None
+        self.run_journal = None
+        self.tracer = None
+        self.regress = None
+        if cfg.obs:
+            from oktopk_tpu.obs.journal import EventBus, RunJournal
+            self.bus = EventBus()
+            self.run_journal = RunJournal(cfg.obs_journal, bus=self.bus)
+            if cfg.obs_trace_on_anomaly:
+                import os
+                import tempfile
+                from oktopk_tpu.obs.tracing import AnomalyTracer
+                tdir = cfg.obs_trace_dir
+                if tdir is None:
+                    tdir = (os.path.join(os.path.dirname(
+                                os.path.abspath(cfg.obs_journal)), "traces")
+                            if cfg.obs_journal
+                            else tempfile.mkdtemp(prefix="oktopk_traces_"))
+                self.tracer = AnomalyTracer(
+                    tdir, bus=self.bus, num_steps=cfg.obs_trace_steps,
+                    max_captures=cfg.obs_max_traces)
+            if cfg.obs_regress_key:
+                from oktopk_tpu.obs.regress import RegressionDetector
+                self.regress = RegressionDetector.from_bench_records(
+                    key=cfg.obs_regress_key, bus=self.bus,
+                    tolerance=cfg.obs_regress_tolerance)
+
         # ---- numeric-health guard + supervisor (resilience/) ----------
         self._fault_plan = fault_plan
         self._guard = None
@@ -131,7 +161,8 @@ class Trainer:
                 max_strikes=cfg.resilience_strikes,
                 divergence_limit=cfg.resilience_divergence_limit,
                 cooldown_steps=cfg.resilience_cooldown,
-                journal=HealthJournal(cfg.resilience_journal))
+                journal=HealthJournal(cfg.resilience_journal,
+                                      bus=self.bus))
             if fault_plan is not None:
                 # chaos drill: announce the planned schedule up front so
                 # the journal distinguishes drills from real corruption
@@ -209,7 +240,7 @@ class Trainer:
                                               cfg.num_buckets))
         return Autotuner(
             sizes, self.cfg.num_workers, policy, runner,
-            journal=DecisionJournal(cfg.autotune_journal))
+            journal=DecisionJournal(cfg.autotune_journal, bus=self.bus))
 
     def autotune(self, step: int = 0, fake_ms=None):
         """Run (or re-run) the calibrate -> trial -> policy pass and adopt
@@ -261,9 +292,14 @@ class Trainer:
 
     def note_checkpoint(self, path: str, step: int) -> None:
         """Register a saved checkpoint as a restore candidate (and record
-        the supervisor's own state next to it, see ``supervisor_extra``)."""
+        the supervisor's own state next to it, see ``supervisor_extra``).
+        Journalled either way: via the supervisor's health journal when
+        resilience is on, straight onto the bus otherwise."""
         if self.supervisor is not None:
             self.supervisor.note_checkpoint(path, step)
+        elif self.bus is not None:
+            self.bus.emit("checkpoint", step=int(step), path=path,
+                          qualified=True)
 
     def supervisor_extra(self):
         """The ``extra`` payload for ``checkpoint.save_checkpoint``: the
@@ -383,8 +419,12 @@ class Trainer:
 
         def flush_pending():
             for s, dm in pending:
-                metric_writer.write(s, {
-                    k: float(np.asarray(v).mean()) for k, v in dm.items()})
+                host = {k: float(np.asarray(v).mean())
+                        for k, v in dm.items()}
+                if metric_writer is not None:
+                    metric_writer.write(s, host)
+                if self.bus is not None:
+                    self.bus.emit("step", step=s, **host)
             pending.clear()
 
         t0 = time.time()
@@ -402,6 +442,11 @@ class Trainer:
             self.maybe_autotune(step)
             if trace is not None:
                 trace.on_step(step)
+            if self.tracer is not None:
+                # anomaly-armed profiler window (obs/tracing.py): opens
+                # here on the step after a guard_trip/fallback event,
+                # closes num_steps later with a trace_captured event
+                self.tracer.on_step(step)
             if timers is not None:
                 with timers.phase("data"):
                     batch = next(data_iter)
@@ -417,15 +462,17 @@ class Trainer:
                 # check cadence; escalation may rebuild step_fn or
                 # restore state before the next iteration
                 self.supervise(step, metrics)
-            if metric_writer is not None:
+            if metric_writer is not None or self.bus is not None:
                 pending.append((step, metrics))
             if "grad_nonfinite" in metrics:
                 nf_window.append(metrics["grad_nonfinite"])
             if (i + 1) % log_every == 0:
-                if metric_writer is not None:
+                if pending:
                     flush_pending()
+                dt = (time.time() - t0) / log_every
+                if self.regress is not None:
+                    self.regress.observe(step, dt * 1e3)
                 if logger:
-                    dt = (time.time() - t0) / log_every
                     # absolute step, not the loop index: after a preemption
                     # resume the log must agree with scalars.csv/checkpoints
                     logger.info(
@@ -441,14 +488,61 @@ class Trainer:
                             "window ending iter %d: %d nonfinite gradient "
                             "elements", step, int(nf))
                     nf_window.clear()
-                    t0 = time.time()
+                if timers is not None and self.bus is not None:
+                    self.bus.emit("phase", step=step,
+                                  phases=timers.summary())
+                t0 = time.time()
             if timers is not None and logger is not None:
                 timers.maybe_log(step, logger)
-        if metric_writer is not None:
+        if pending:
             flush_pending()
+        if self.tracer is not None:
+            self.tracer.finish(self.last_step)
+        if self.bus is not None:
+            self._emit_volume_report()
         self.metrics_history.append(
             {k: float(np.asarray(v).mean()) for k, v in metrics.items()})
         return metrics
+
+    def _bucket_plan(self):
+        """Per-bucket (algo name, density) after autotune plans and forced
+        dense fallbacks — the same resolution :meth:`_build_step`
+        performs, exposed for reporting."""
+        nb = max(1, self.cfg.num_buckets)
+        names = [self.cfg.compressor] * nb
+        densities = [self.cfg.density] * nb
+        if self._plans:
+            names = [p.algo for p in self._plans]
+            densities = [p.density for p in self._plans]
+        for b in self._forced_dense:
+            if 0 <= b < nb:
+                names[b] = "dense"
+                densities[b] = 1.0
+        return names, densities
+
+    def _emit_volume_report(self):
+        """One ``volume_report`` event per bucket: mean realised wire
+        bytes per step (from the SparseState accounting) against the
+        algorithm's analytic budget (obs/volume.py). The mean covers the
+        WHOLE run — dense warmup steps and exact recomputes included —
+        so a warmed-up sparse run legitimately reports above the
+        steady-state budget; the per-algorithm conformance guarantee is
+        asserted by the steady-state tests, not here."""
+        from oktopk_tpu.obs import volume as obs_volume
+        names, densities = self._bucket_plan()
+        single = self.cfg.num_buckets <= 1
+        sps = ([self.state.sparse_state] if single
+               else list(self.state.sparse_state))
+        for b, (nm, dens) in enumerate(zip(names, densities)):
+            sp = sps[b]
+            steps_done = int(np.asarray(sp.step)[0])
+            wb = float(np.asarray(sp.wire_bytes)[0])
+            n_b = int(np.asarray(sp.residual).shape[-1])
+            cfg_b = self.algo_cfg.replace(n=n_b, density=float(dens))
+            rep = obs_volume.volume_report(
+                nm, cfg_b, wb / max(1, steps_done), bucket=b,
+                step=getattr(self, "last_step", 0), steps=steps_done)
+            self.bus.emit("volume_report", **rep)
 
     # ---- elasticity ---------------------------------------------------
 

@@ -9,21 +9,35 @@ import socket
 from typing import Optional
 
 
+def _fmt() -> logging.Formatter:
+    host = socket.gethostname()
+    return logging.Formatter(
+        f"%(asctime)s [{host}] %(levelname)s %(name)s: %(message)s")
+
+
 def get_logger(name: str = "oktopk_tpu", logfile: Optional[str] = None,
                level=logging.INFO) -> logging.Logger:
     logger = logging.getLogger(name)
-    if logger.handlers:
-        return logger
-    logger.setLevel(level)
-    host = socket.gethostname()
-    fmt = logging.Formatter(
-        f"%(asctime)s [{host}] %(levelname)s %(name)s: %(message)s")
-    sh = logging.StreamHandler()
-    sh.setFormatter(fmt)
-    logger.addHandler(sh)
+    if not logger.handlers:
+        logger.setLevel(level)
+        sh = logging.StreamHandler()
+        sh.setFormatter(_fmt())
+        logger.addHandler(sh)
     if logfile:
-        os.makedirs(os.path.dirname(logfile), exist_ok=True)
-        fh = logging.FileHandler(logfile)
-        fh.setFormatter(fmt)
-        logger.addHandler(fh)
+        # A later call with a logfile must still attach it: the old
+        # if-handlers early-return silently dropped the file when the
+        # logger had already been created (e.g. console-only at import,
+        # per-experiment file once the rundir exists).
+        target = os.path.abspath(logfile)
+        attached = any(
+            isinstance(h, logging.FileHandler)
+            and getattr(h, "baseFilename", None) == target
+            for h in logger.handlers)
+        if not attached:
+            d = os.path.dirname(target)
+            if d:
+                os.makedirs(d, exist_ok=True)
+            fh = logging.FileHandler(target)
+            fh.setFormatter(_fmt())
+            logger.addHandler(fh)
     return logger

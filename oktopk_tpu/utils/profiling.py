@@ -42,8 +42,12 @@ class PhaseTimers:
     resets, like the reference's 50-step cadence.
     """
 
-    def __init__(self, every: int = 50):
+    def __init__(self, every: int = 50, sink=None):
         self.every = every
+        # optional obs.tracing.ChromeTraceSink (anything with
+        # add(name, ts_s, dur_s)): every phase sample also becomes a
+        # Chrome trace-event for chrome://tracing / Perfetto
+        self.sink = sink
         self._samples: Dict[str, list] = defaultdict(list)
 
     @contextmanager
@@ -52,7 +56,10 @@ class PhaseTimers:
         try:
             yield
         finally:
-            self._samples[name].append(time.perf_counter() - t0)
+            dur = time.perf_counter() - t0
+            self._samples[name].append(dur)
+            if self.sink is not None:
+                self.sink.add(name, t0, dur)
 
     def add(self, name: str, seconds: float) -> None:
         self._samples[name].append(seconds)
@@ -61,10 +68,27 @@ class PhaseTimers:
         rows = [f"{'phase':<14}{'mean_ms':>10}{'total_s':>10}{'count':>8}"]
         for name in sorted(self._samples):
             s = self._samples[name]
+            if not s:
+                # defaultdict access can register a phase with no
+                # samples; render it instead of dividing by zero
+                rows.append(f"{name:<14}{'-':>10}{'-':>10}{0:>8d}")
+                continue
             mean = sum(s) / len(s)
             rows.append(
                 f"{name:<14}{mean * 1e3:>10.2f}{sum(s):>10.3f}{len(s):>8d}")
         return "\n".join(rows)
+
+    def summary(self) -> Dict[str, Dict[str, float]]:
+        """Machine-readable form of :meth:`table` (for the run
+        journal's ``phase`` events)."""
+        out: Dict[str, Dict[str, float]] = {}
+        for name, s in self._samples.items():
+            out[name] = {
+                "mean_ms": (sum(s) / len(s) * 1e3) if s else 0.0,
+                "total_s": float(sum(s)),
+                "count": float(len(s)),
+            }
+        return out
 
     def reset(self) -> None:
         self._samples.clear()
@@ -164,15 +188,29 @@ class TraceWindow:
 
 @contextmanager
 def trace_window(logdir: str):
-    """Trace everything inside the block (convenience for benchmarks)."""
+    """Trace everything inside the block (convenience for benchmarks).
+
+    Degrades to a no-op when the profiler cannot start (CPU-only
+    backends without profiler support, or a trace already running —
+    e.g. nested inside an obs/tracing.py anomaly window): the traced
+    code must run either way."""
     import jax
 
-    os.makedirs(logdir, exist_ok=True)
-    jax.profiler.start_trace(logdir)
+    started = False
+    try:
+        os.makedirs(logdir, exist_ok=True)
+        jax.profiler.start_trace(logdir)
+        started = True
+    except Exception:
+        pass
     try:
         yield
     finally:
-        jax.profiler.stop_trace()
+        if started:
+            try:
+                jax.profiler.stop_trace()
+            except Exception:
+                pass
 
 
 def device_memory_stats(device=None) -> Dict[str, float]:

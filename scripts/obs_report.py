@@ -1,0 +1,197 @@
+#!/usr/bin/env python
+"""Render a human-readable report from a unified run journal.
+
+Usage:
+    python scripts/obs_report.py logs/<slug>/run_journal.jsonl
+
+Sections (each omitted when the journal has no matching events):
+
+- environment header (jax/jaxlib/device/world, schema version)
+- step metrics summary (first/last loss, mean wire bytes, skips)
+- per-bucket volume-vs-budget table with conformance ratios
+- autotune decision log (per-bucket chosen algorithm + reason)
+- host phase table (latest ``phase`` event)
+- incident timeline: faults, guard trips, fallbacks, restores,
+  checkpoints, trace captures and regressions in step order
+
+Works on any JSONL journal that validates against
+``oktopk_tpu.obs.events`` (see docs/OBSERVABILITY.md).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+from typing import Any, Dict, List
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# events rendered on the incident timeline, in journal order
+_INCIDENT_EVENTS = ("fault_seen", "guard_trip", "fallback", "restore",
+                    "restore_unavailable", "checkpoint",
+                    "trace_captured", "regression")
+
+
+def _fmt_bytes(b: float) -> str:
+    for unit in ("B", "KiB", "MiB", "GiB"):
+        if abs(b) < 1024 or unit == "GiB":
+            return f"{b:.1f}{unit}"
+        b /= 1024
+    return f"{b:.1f}GiB"
+
+
+def _header_lines(entries: List[Dict[str, Any]]) -> List[str]:
+    hdr = next((e for e in entries if e.get("event") == "header"), None)
+    if hdr is None:
+        return ["(no environment header)"]
+    return [
+        "environment: jax {jax} jaxlib {jaxlib} on {world_size}x "
+        "{device_kind} ({platform}), schema v{schema_version}".format(
+            jax=hdr.get("jax"), jaxlib=hdr.get("jaxlib"),
+            world_size=hdr.get("world_size"),
+            device_kind=hdr.get("device_kind"),
+            platform=hdr.get("platform"),
+            schema_version=hdr.get("schema_version", "?"))]
+
+
+def _steps_lines(entries: List[Dict[str, Any]]) -> List[str]:
+    steps = [e for e in entries if e.get("event") == "step"]
+    if not steps:
+        return []
+    out = [f"steps: {len(steps)} journalled "
+           f"({steps[0]['step']}..{steps[-1]['step']})"]
+    losses = [e["loss"] for e in steps if isinstance(
+        e.get("loss"), (int, float))]
+    if losses:
+        out.append(f"  loss: first {losses[0]:.4f}  last {losses[-1]:.4f}")
+    wires = [e["wire_bytes"] for e in steps if isinstance(
+        e.get("wire_bytes"), (int, float))]
+    if wires:
+        out.append("  wire bytes/step: mean "
+                   f"{_fmt_bytes(sum(wires) / len(wires))}")
+    skipped = sum(int(e.get("step_skipped", 0)) for e in steps)
+    if skipped:
+        out.append(f"  guard-skipped steps: {skipped}")
+    return out
+
+
+def _volume_lines(entries: List[Dict[str, Any]]) -> List[str]:
+    reports = [e for e in entries if e.get("event") == "volume_report"]
+    if not reports:
+        return []
+    out = ["volume conformance (measured mean vs analytic budget):",
+           f"  {'bucket':>6} {'algo':<14} {'mean/step':>12} "
+           f"{'budget':>12} {'ratio':>7}"]
+    for r in reports:
+        ratio = r.get("conformance_ratio")
+        ratio_s = (f"{ratio:>7.3f}"
+                   if isinstance(ratio, (int, float)) else f"{'?':>7}")
+        out.append(
+            f"  {r.get('bucket', '?'):>6} {r.get('algo', '?'):<14} "
+            f"{_fmt_bytes(float(r.get('mean_wire_bytes', 0))):>12} "
+            f"{_fmt_bytes(float(r.get('budget_bytes', 0))):>12} "
+            + ratio_s)
+    return out
+
+
+def _autotune_lines(entries: List[Dict[str, Any]]) -> List[str]:
+    # both names: "autotune_decision" on the unified bus, "decision" in
+    # a standalone DecisionJournal file fed to this report directly
+    decs = [e for e in entries
+            if e.get("event") in ("autotune_decision", "decision")]
+    if not decs:
+        return []
+    out = ["autotune decisions:"]
+    for d in decs:
+        chosen = d.get("chosen") or {}
+        out.append(
+            f"  step {d.get('step', '?'):>5} bucket {d.get('bucket', '?')}"
+            f": {chosen.get('algo', '?')} "
+            f"density {chosen.get('density', '?')} ({d.get('reason', '?')})")
+    return out
+
+
+def _phase_lines(entries: List[Dict[str, Any]]) -> List[str]:
+    phases = [e for e in entries if e.get("event") == "phase"]
+    if not phases:
+        return []
+    last = phases[-1]
+    out = [f"host phases (step {last.get('step', '?')}):",
+           f"  {'phase':<14}{'mean_ms':>10}{'total_s':>10}{'count':>8}"]
+    for name, st in sorted((last.get("phases") or {}).items()):
+        out.append(f"  {name:<14}{st.get('mean_ms', 0):>10.2f}"
+                   f"{st.get('total_s', 0):>10.3f}"
+                   f"{int(st.get('count', 0)):>8d}")
+    return out
+
+
+def _timeline_lines(entries: List[Dict[str, Any]]) -> List[str]:
+    inc = [e for e in entries if e.get("event") in _INCIDENT_EVENTS]
+    if not inc:
+        return []
+    out = ["incident timeline:"]
+    for e in inc:
+        ev, step = e["event"], e.get("step", "?")
+        if ev == "fault_seen":
+            detail = f"{e.get('kind')} buckets={e.get('buckets')}"
+        elif ev == "guard_trip":
+            detail = (f"buckets={e.get('buckets')} "
+                      f"skips={e.get('consecutive_skips')}")
+        elif ev == "fallback":
+            detail = (f"bucket {e.get('bucket')} -> {e.get('algo')} "
+                      f"({e.get('strikes')} strikes)")
+        elif ev == "restore":
+            detail = f"from {e.get('ckpt')} @ {e.get('last_good_step')}"
+        elif ev == "restore_unavailable":
+            detail = f"no good checkpoint (last={e.get('last_good_step')})"
+        elif ev == "checkpoint":
+            q = "" if e.get("qualified") else " (NOT a restore target)"
+            detail = f"{e.get('path')}{q}"
+        elif ev == "trace_captured":
+            detail = (f"{e.get('num_steps')} steps from "
+                      f"{e.get('start_step')} -> {e.get('logdir')} "
+                      f"[{e.get('trigger')}]")
+        else:  # regression
+            detail = (f"{e.get('ms', 0):.1f}ms vs baseline "
+                      f"{e.get('baseline_ms', 0):.1f}ms "
+                      f"(x{e.get('ratio', 0):.2f})")
+        out.append(f"  step {step:>5}  {ev:<19} {detail}")
+    return out
+
+
+def render_report(entries: List[Dict[str, Any]]) -> str:
+    """The full report for one journal's entries."""
+    from oktopk_tpu.obs.events import validate_journal
+
+    sections = [_header_lines(entries), _steps_lines(entries),
+                _volume_lines(entries), _autotune_lines(entries),
+                _phase_lines(entries), _timeline_lines(entries)]
+    lines: List[str] = ["== run journal report =="]
+    for sec in sections:
+        if sec:
+            lines.extend(sec)
+            lines.append("")
+    problems = validate_journal(entries)
+    if problems:
+        lines.append(f"schema problems ({len(problems)}):")
+        lines.extend(f"  {p}" for p in problems[:20])
+    else:
+        lines.append("schema: OK")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("journal", help="run_journal.jsonl path")
+    args = ap.parse_args(argv)
+
+    from oktopk_tpu.autotune.journal import read_journal
+
+    entries = read_journal(args.journal)
+    print(render_report(entries))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,168 @@
+"""Run-journal event schemas: every real emitter validates, and the
+validator actually rejects malformed journals.
+
+The emitters under test are the REAL ones — DecisionJournal,
+HealthJournal via a host-driven Supervisor, RunJournal, AnomalyTracer,
+RegressionDetector — not hand-built dicts, so a schema drift in any of
+them fails here before it corrupts a run journal in the field.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from oktopk_tpu.autotune.journal import (DecisionJournal,
+                                         environment_header, read_journal)
+from oktopk_tpu.obs.events import (EVENT_SCHEMAS, SCHEMA_VERSION,
+                                   validate_event, validate_journal)
+from oktopk_tpu.obs.journal import EventBus, RunJournal
+from oktopk_tpu.obs.regress import RegressionDetector
+from oktopk_tpu.obs.tracing import AnomalyTracer
+from oktopk_tpu.resilience.journal import HealthJournal
+from oktopk_tpu.resilience.supervisor import Supervisor
+
+pytestmark = pytest.mark.obs
+
+
+def _drive_supervisor(bus):
+    """Host-driven incident: trips -> fallback -> divergence with no
+    good checkpoint -> restore_unavailable -> a later qualified
+    checkpoint."""
+    sup = Supervisor(num_buckets=2, max_strikes=2, divergence_limit=3,
+                     cooldown_steps=0,
+                     journal=HealthJournal(bus=bus))
+    sup.journal.fault_seen(0, "planned:wire_bitflip", buckets=[1])
+    trip = {"step_skipped": np.asarray(1),
+            "bucket_anomalies": np.asarray([0, 1], np.int32)}
+    for s in (1, 2, 3):
+        sup.observe(s, trip)
+    # the restore consumed the skip streak, so this one qualifies
+    sup.note_checkpoint("/tmp/ckpt-3", 3)
+    return sup
+
+
+class TestEmittersValidate:
+    def test_environment_header_carries_schema_version(self):
+        hdr = environment_header()
+        assert hdr["schema_version"] == SCHEMA_VERSION
+        assert validate_event({"event": "header", **hdr}) == []
+
+    def test_unified_journal_from_real_emitters(self, tmp_path):
+        """Every emitter writes through one bus into one RunJournal;
+        the result is schema-clean with exactly one header."""
+        bus = EventBus()
+        rj = RunJournal(str(tmp_path / "run.jsonl"), bus=bus)
+
+        dj = DecisionJournal(str(tmp_path / "decisions.jsonl"), bus=bus)
+        dj.record("calibration", step=0, num_workers=8,
+                  alpha=1e-6, beta=1e-11, source="default")
+        dj.record("decision", step=0, bucket=0, n=1024, num_workers=8,
+                  candidates=[], chosen={"algo": "oktopk",
+                                         "density": 0.02},
+                  incumbent=None, reason="trial")
+
+        tracer = AnomalyTracer(str(tmp_path / "traces"), bus=bus,
+                               num_steps=1, max_captures=1)
+        sup = _drive_supervisor(bus)
+        assert sup.fallback_events == 1
+        assert sup.restore_events == 1
+        assert sup.last_good_ckpt == "/tmp/ckpt-3"
+
+        tracer.on_step(4)       # opens (armed by the guard trips)
+        tracer.on_step(5)       # closes -> trace_captured
+
+        rd = RegressionDetector(baseline_ms=100.0, tolerance=1.5,
+                                warmup_windows=0, bus=bus, key="oktopk_ms")
+        rd.observe(6, 500.0)
+
+        bus.emit("step", step=7, loss=0.5, wire_bytes=1234.0)
+        bus.emit("volume_report", step=7, bucket=0, algo="oktopk",
+                 budget_bytes=100.0, mean_wire_bytes=80.0,
+                 conformance_ratio=0.8)
+
+        file_entries = read_journal(str(tmp_path / "run.jsonl"))
+        assert validate_journal(file_entries) == []
+        events = [e["event"] for e in file_entries]
+        assert events.count("header") == 1
+        for expected in ("autotune_decision", "calibration", "fault_seen",
+                         "guard_trip", "fallback", "restore_unavailable",
+                         "checkpoint", "trace_captured", "regression",
+                         "step", "volume_report"):
+            assert expected in events, f"missing {expected}"
+        assert bus.dropped == 0
+
+    def test_standalone_files_stay_valid_views(self, tmp_path):
+        """The thin-view journals keep their own headers and validate
+        on their own — the bus retrofit must not break the standalone
+        format the earlier tooling reads."""
+        bus = EventBus()
+        RunJournal(str(tmp_path / "run.jsonl"), bus=bus)
+        dj = DecisionJournal(str(tmp_path / "decisions.jsonl"), bus=bus)
+        dj.record("decision", step=0, bucket=0,
+                  chosen={"algo": "dense", "density": 1.0}, reason="trial")
+        hj = HealthJournal(str(tmp_path / "health.jsonl"), bus=bus)
+        hj.guard_trip(1, [0], 1, [1])
+
+        dec = read_journal(str(tmp_path / "decisions.jsonl"))
+        assert [e["event"] for e in dec] == ["header", "decision"]
+        assert validate_journal(dec) == []
+        health = read_journal(str(tmp_path / "health.jsonl"))
+        assert [e["event"] for e in health] == ["header", "guard_trip"]
+        assert validate_journal(health) == []
+
+        # the unified file got the SAME payloads, decision renamed
+        run = read_journal(str(tmp_path / "run.jsonl"))
+        assert [e["event"] for e in run] == [
+            "header", "autotune_decision", "guard_trip"]
+        assert run[1]["chosen"] == dec[1]["chosen"]
+        assert run[2]["buckets"] == health[1]["buckets"]
+
+    def test_bus_subscriber_failure_never_raises(self):
+        bus = EventBus()
+
+        def bad(entry):
+            raise RuntimeError("boom")
+
+        bus.subscribe(bad)
+        bus.emit("step", step=1)
+        assert bus.dropped == 1
+
+
+class TestValidatorRejects:
+    def test_unknown_event(self):
+        assert validate_event({"event": "teleport", "step": 1})
+
+    def test_missing_event_field(self):
+        assert validate_event({"step": 1})
+
+    def test_missing_required_field(self):
+        probs = validate_event({"event": "fallback", "step": 1,
+                                "bucket": 0, "algo": "dense"})
+        assert any("strikes" in p for p in probs)
+
+    def test_wrong_type(self):
+        probs = validate_event({"event": "guard_trip", "step": 1,
+                                "buckets": "zero", "consecutive_skips": 1,
+                                "strikes": []})
+        assert any("buckets" in p for p in probs)
+
+    def test_extra_fields_allowed(self):
+        assert validate_event({"event": "step", "step": 1,
+                               "my_custom_metric": 3.0}) == []
+
+    def test_journal_invariants(self):
+        hdr = {"event": "header", **environment_header()}
+        step = {"event": "step", "step": 1}
+        assert validate_journal([]) == ["journal is empty"]
+        assert any("not an environment header" in p
+                   for p in validate_journal([step]))
+        assert any("exactly 1 header" in p
+                   for p in validate_journal([hdr, hdr, step]))
+        assert validate_journal([hdr, step]) == []
+
+    def test_every_schema_has_required_step_except_header(self):
+        for name, schema in EVENT_SCHEMAS.items():
+            if name == "header":
+                continue
+            assert "step" in schema["required"], name

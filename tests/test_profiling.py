@@ -2,16 +2,20 @@
 VGG/allreducer.py:256-262,379-439 and memory logging VGG/dl_trainer.py:697)."""
 
 import csv
+import json
+import logging
 import time
 
 import jax
 
+from oktopk_tpu.utils.logging import get_logger
 from oktopk_tpu.utils.profiling import (
     MetricWriter,
     PhaseTimers,
     TraceWindow,
     device_memory_stats,
     host_memory_stats,
+    trace_window,
 )
 
 
@@ -40,6 +44,45 @@ class TestPhaseTimers:
         assert len(logs) == 1
         # reset happened: nothing to log next cadence
         assert not t.maybe_log(4, L())
+
+    def test_table_renders_empty_phase(self):
+        t = PhaseTimers()
+        t._samples["ghost"]  # defaultdict access registers sample-less phase
+        t.add("step", 0.25)
+        tab = t.table()
+        ghost_row = next(r for r in tab.splitlines() if "ghost" in r)
+        assert "-" in ghost_row
+        assert "step" in tab
+
+    def test_summary_matches_samples(self):
+        t = PhaseTimers()
+        t.add("step", 0.1)
+        t.add("step", 0.3)
+        t._samples["ghost"]
+        s = t.summary()
+        assert s["step"]["count"] == 2
+        assert s["step"]["total_s"] == 0.4
+        assert abs(s["step"]["mean_ms"] - 200.0) < 1e-6
+        assert s["ghost"] == {"mean_ms": 0.0, "total_s": 0.0, "count": 0.0}
+
+    def test_sink_receives_chrome_trace_events(self, tmp_path):
+        from oktopk_tpu.obs.tracing import ChromeTraceSink
+
+        sink = ChromeTraceSink()
+        t = PhaseTimers(sink=sink)
+        with t.phase("data"):
+            pass
+        with t.phase("step"):
+            pass
+        path = str(tmp_path / "phases.trace.json")
+        sink.write(path)
+        with open(path) as f:
+            doc = json.load(f)
+        names = [ev["name"] for ev in doc["traceEvents"]]
+        assert names == ["data", "step"]
+        for ev in doc["traceEvents"]:
+            assert ev["ph"] == "X"
+            assert ev["dur"] >= 0
 
 
 class TestMetricWriter:
@@ -95,8 +138,79 @@ def test_trace_window_produces_trace(tmp_path):
     assert found, "trace produced no files"
 
 
+def test_trace_window_noop_when_profiler_unavailable(tmp_path, monkeypatch):
+    """CPU backends without profiler support must not break the traced
+    code: the block still runs, and stop is never attempted."""
+    def boom(*a, **k):
+        raise RuntimeError("profiler unavailable")
+
+    stops = []
+    monkeypatch.setattr(jax.profiler, "start_trace", boom)
+    monkeypatch.setattr(jax.profiler, "stop_trace",
+                        lambda: stops.append(1))
+    ran = []
+    with trace_window(str(tmp_path / "t")):
+        ran.append(1)
+    assert ran == [1]
+    assert stops == []  # never started, so never stopped
+
+
+def test_trace_window_tolerates_nesting(tmp_path):
+    """A trace_window nested inside an already-open trace (e.g. an
+    obs/tracing.py anomaly window) degrades to a no-op instead of
+    raising out of the traced code."""
+    ran = []
+    with trace_window(str(tmp_path / "outer")):
+        with trace_window(str(tmp_path / "inner")):
+            ran.append(1)
+    assert ran == [1]
+
+
 def test_memory_stats_shapes():
     stats = device_memory_stats()
     assert isinstance(stats, dict)  # may be {} on CPU
     host = host_memory_stats()
     assert host.get("host_rss_bytes", 1.0) > 0
+
+
+def test_device_memory_stats_handles_statless_device():
+    class NoStats:  # CPU-like device object without memory_stats
+        pass
+
+    class NullStats:
+        def memory_stats(self):
+            return None
+
+    class Full:
+        def memory_stats(self):
+            return {"bytes_in_use": 7, "bytes_limit": 100,
+                    "num_allocs": 3}  # extraneous key is dropped
+
+    assert device_memory_stats(NoStats()) == {}
+    assert device_memory_stats(NullStats()) == {}
+    assert device_memory_stats(Full()) == {
+        "bytes_in_use": 7.0, "bytes_limit": 100.0}
+
+
+def test_get_logger_attaches_logfile_to_existing_logger(tmp_path):
+    """The console-only logger created at import time must still gain
+    the per-experiment file handler once the rundir exists (the old
+    early-return dropped it), without duplicating on repeat calls."""
+    name = "oktopk_tpu.test_logfile_attach"
+    lg = get_logger(name)  # console-only first
+    logfile = str(tmp_path / "run" / "train.log")
+    try:
+        lg2 = get_logger(name, logfile=logfile)
+        assert lg2 is lg
+        lg.info("hello-logfile")
+        get_logger(name, logfile=logfile)  # idempotent
+        fhs = [h for h in lg.handlers
+               if isinstance(h, logging.FileHandler)]
+        assert len(fhs) == 1
+        fhs[0].flush()
+        with open(logfile) as f:
+            assert "hello-logfile" in f.read()
+    finally:
+        for h in list(lg.handlers):
+            h.close()
+            lg.removeHandler(h)
