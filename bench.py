@@ -144,11 +144,13 @@ def step_time_probe(iters=10):
             ("dense_bf16", "dense", 1, "bfloat16", 16)):
         times = None
         batch = batches[bs]
-        # the Pallas selection kernel is auto-enabled on TPU meshes; if its
-        # Mosaic compile fails on this chip generation, fall back to the
-        # portable selection path so the record still carries an oktopk
-        # step time (flagged via oktopk_pallas_failed)
-        for use_pallas in (None, False):
+        # the Pallas selection kernels are auto-enabled on TPU meshes; if a
+        # Mosaic compile fails on this chip generation, degrade one rung at
+        # a time so the record still carries an oktopk step time: first
+        # drop only the fused single-sweep front-end (oktopk_fused_failed;
+        # the per-pass Pallas kernels keep running), then the whole Pallas
+        # selection path (oktopk_pallas_failed)
+        for use_pallas, fuse in ((None, None), (None, False), (False, None)):
             try:
                 cfg = TrainConfig(dnn="vgg16", dataset="cifar10",
                                   batch_size=bs,
@@ -156,7 +158,11 @@ def step_time_probe(iters=10):
                                   density=0.02, num_workers=1,
                                   num_buckets=buckets, compute_dtype=dt)
                 from oktopk_tpu.config import OkTopkConfig
-                acfg = OkTopkConfig(use_pallas=use_pallas)
+                acfg = OkTopkConfig(use_pallas=use_pallas,
+                                    fuse_select=fuse)
+                if comp == "oktopk":
+                    out.setdefault("threshold_method",
+                                   acfg.threshold_method)
                 trainer = Trainer(cfg, mesh=mesh, warmup=False,
                                   algo_cfg=acfg)
                 _ = _time_steps(trainer, batch, 2)    # compile + warm
@@ -168,10 +174,11 @@ def step_time_probe(iters=10):
                 break
             except Exception as e:
                 print(f"[bench] {name} probe "
-                      f"(use_pallas={use_pallas}) failed: {e!r}",
+                      f"(use_pallas={use_pallas}, fuse_select={fuse}) "
+                      f"failed: {e!r}",
                       file=sys.stderr)
                 # only a kernel-compile failure justifies switching the
-                # headline number to the portable selection path — a
+                # headline number to a degraded selection path — a
                 # transient tunnel error must not be misattributed
                 looks_compile = any(t in repr(e) for t in
                                     ("Mosaic", "mosaic", "Pallas",
@@ -179,12 +186,16 @@ def step_time_probe(iters=10):
                 if (comp != "oktopk" or use_pallas is False
                         or not looks_compile):
                     break
-                out[f"{name}_pallas_failed"] = True
+                if fuse is None:
+                    out[f"{name}_fused_failed"] = True
+                else:
+                    out[f"{name}_pallas_failed"] = True
         if times is None:
             # a config that fails to compile/run must not take down the
             # others' numbers (first contact already succeeded by here);
-            # and without a fallback measurement the flag would imply one
+            # and without a fallback measurement the flags would imply one
             out.pop(f"{name}_pallas_failed", None)
+            out.pop(f"{name}_fused_failed", None)
             continue
         ms = [t * 1e3 for t in times]
         out[f"{name}_ms"] = statistics.median(ms)
@@ -388,6 +399,8 @@ def main():
                     "dense_bf16_bs256_ms", "dense_bf16_bs256_ms_std",
                     "oktopk_pallas_failed", "oktopk_bs256_pallas_failed",
                     "oktopk_b4_pallas_failed",
+                    "oktopk_fused_failed", "oktopk_bs256_fused_failed",
+                    "oktopk_b4_fused_failed", "threshold_method",
                     "flops_per_step", "flops_per_step_bs256",
                     "flops_per_step_bs256_scaled", "peak_flops_assumed",
                     "peak_flops_bf16_assumed",

@@ -33,7 +33,7 @@ def batched_init_state(cfg: OkTopkConfig, dtype=jnp.float32) -> SparseState:
 
 def build_allreduce_step(name: str, cfg: OkTopkConfig, mesh: Mesh,
                          axis_name: str = "data", warmup: bool = True,
-                         check_vma: bool = True):
+                         check_vma: bool = True, donate_state: bool = False):
     """jit-compiled ``(grads [P, n], state) -> (results [P, n], state)``.
 
     ``results`` is the same reduced vector replicated per worker row (every
@@ -42,6 +42,14 @@ def build_allreduce_step(name: str, cfg: OkTopkConfig, mesh: Mesh,
     ``check_vma=False`` disables shard_map's varying-axes tracking — needed
     when running the Pallas selection kernel through its interpreter on a
     CPU mesh (the interpreter cannot mix VMA-tracked operands).
+
+    ``donate_state=True`` donates the state argument's buffers to the call,
+    letting XLA write the new residual (and the oktopk phase-(a) ``reduced``
+    scratch) into the old residual's n-length allocation instead of
+    materialising a second dense buffer. Opt-in because a donated state is
+    consumed: callers that re-use one state across calls — e.g. the
+    profiling loops in scripts/profile_step.py — must leave it off, while
+    the train-loop pattern ``out, state = step(g, state)`` is safe.
     """
     from oktopk_tpu.ops.compaction import resolve_use_pallas
     cfg = resolve_use_pallas(cfg, mesh)
@@ -57,6 +65,8 @@ def build_allreduce_step(name: str, cfg: OkTopkConfig, mesh: Mesh,
     mapped = compat.shard_map(shard_fn, mesh=mesh,
                               in_specs=(spec, spec), out_specs=(spec, spec),
                               check_vma=check_vma)
+    if donate_state:
+        return jax.jit(mapped, donate_argnums=(1,))
     return jax.jit(mapped)
 
 

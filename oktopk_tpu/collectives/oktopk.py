@@ -44,6 +44,15 @@ from oktopk_tpu.ops import (
     select_mask,
 )
 from oktopk_tpu.ops.topk import k2threshold_method
+from oktopk_tpu.ops.hist_threshold import (
+    hist_to_threshold,
+    k2threshold_hist,
+    log2_hist,
+)
+from oktopk_tpu.ops.fused_select import (
+    fused_pack_finalize,
+    fused_select_stage,
+)
 from oktopk_tpu.ops.residual import add_residual
 from oktopk_tpu.collectives.wire import (
     on_wire as _on_wire,
@@ -131,8 +140,28 @@ def oktopk(grad: jnp.ndarray, state: SparseState, cfg: OkTopkConfig,
     # fixed-capacity buffer stays sized by the max density (config.py).
     k = scheduled_k(cfg, state.step)
     rank = axis_rank(axis_name)
-    acc = add_residual(grad, state.residual)
-    abs_acc = jnp.abs(acc)
+    up = bool(cfg.use_pallas)
+    hist_mode = cfg.threshold_method == "hist"
+    # Fused selection front-end (ops/fused_select.py): ONE Pallas sweep
+    # over (grad, residual) yields acc, the staging rows, the realised and
+    # Newton-probe counts, and the threshold histogram — replacing the
+    # separate add_residual / abs / mask / count / probe / pack passes
+    # below. The unfused path stays as the bit-parity oracle
+    # (tests/test_fused_select.py) and bench.py's degradation rung
+    # (cfg.fuse_select=False -> `oktopk_fused_failed`).
+    fuse = (up and cfg.fuse_select is not False
+            and grad.dtype == jnp.float32)
+    if not fuse:
+        acc = add_residual(grad, state.residual)
+        abs_acc = jnp.abs(acc)
+
+    def _abs_acc_branch():
+        # fused steps carry no precomputed |acc| buffer; the rare branches
+        # that need one (exact bisect recompute, first-sparse hist prime)
+        # recompute it inside their cond — bit-identical values, and the
+        # extra sweeps price only the steps that take the branch
+        return jnp.abs(add_residual(grad, state.residual)) if fuse \
+            else abs_acc
 
     # The reference's warmup length is a multiple of the recompute cadence
     # (512 % 32 == 0, VGG/allreducer.py:573,577) so its first sparse step
@@ -154,50 +183,97 @@ def oktopk(grad: jnp.ndarray, state: SparseState, cfg: OkTopkConfig,
     # BOTH thresholds by that rate — "prediction instead of recomputation"
     # (VGG/allreducer.py:593) applied to the drift as well as the level.
     prev_lt = state.local_threshold
+    tkl = _target_k(k, n, cfg.local_k_target)
 
-    def lt_exact():
-        # exact recompute lands the count at the local setpoint (<= k,
-        # inside the reference band) rather than exactly k: phase-(a)
-        # volume is 4*count*(P-1)/P, so the setpoint directly buys budget
-        # margin at the same nominal density
-        lt_new = k2threshold_method(abs_acc,
-                                    _target_k(k, n, cfg.local_k_target),
-                                    cfg.threshold_method,
-                                    cfg.bisect_iters).astype(acc.dtype)
-        # drift measured between consecutive *exact* thresholds (the
-        # running predicted one is polluted by the controller's own
-        # corrections), as a per-step rate over the elapsed window
-        gap = max(1, cfg.local_recompute_every)
-        base_lt = state.last_exact_lt
-        ratio = jnp.where((lt_new > 0) & (base_lt > 0),
-                          lt_new / jnp.maximum(base_lt, 1e-30), 1.0)
-        per_step = jnp.clip(ratio ** (1.0 / gap),
-                            cfg.drift_clip_lo, cfg.drift_clip_hi)
-        # EMA over recompute windows damps oscillation; the first exact
-        # recompute has no meaningful baseline -> keep drift
-        mixed = ((1.0 - cfg.drift_ema) * state.drift
-                 + cfg.drift_ema * per_step)
-        drift_new = jnp.where(base_lt > 0, mixed, state.drift)
-        return lt_new, drift_new.astype(acc.dtype), lt_new
+    if hist_mode:
+        # LAGGED exact recompute (config.threshold_method="hist"): every
+        # step selects with the carried drift-predicted threshold; the
+        # exact level is read off the histogram this same selection pass
+        # emits (zero extra passes fused, one standalone) and becomes
+        # lt_next in the controller block below — next step's
+        # ``prev_lt * drift`` compensates the one step of staleness. Only
+        # the first sparse step, which has no carried threshold yet, pays
+        # a standalone one-pass histogram prime inside the cond.
+        def lt_prime():
+            return k2threshold_hist(_abs_acc_branch(),
+                                    tkl).astype(grad.dtype)
 
-    def lt_predicted():
-        return prev_lt * state.drift, state.drift, state.last_exact_lt
+        lt = lax.cond(first_sparse, lt_prime,
+                      lambda: prev_lt * state.drift)
+        drift = state.drift   # re-measured from the histogram below
+    else:
+        def lt_exact():
+            # exact recompute lands the count at the local setpoint (<= k,
+            # inside the reference band) rather than exactly k: phase-(a)
+            # volume is 4*count*(P-1)/P, so the setpoint directly buys
+            # budget margin at the same nominal density
+            lt_new = k2threshold_method(_abs_acc_branch(), tkl,
+                                        cfg.threshold_method,
+                                        cfg.bisect_iters).astype(grad.dtype)
+            # drift measured between consecutive *exact* thresholds (the
+            # running predicted one is polluted by the controller's own
+            # corrections), as a per-step rate over the elapsed window
+            gap = max(1, cfg.local_recompute_every)
+            base_lt = state.last_exact_lt
+            ratio = jnp.where((lt_new > 0) & (base_lt > 0),
+                              lt_new / jnp.maximum(base_lt, 1e-30), 1.0)
+            per_step = jnp.clip(ratio ** (1.0 / gap),
+                                cfg.drift_clip_lo, cfg.drift_clip_hi)
+            # EMA over recompute windows damps oscillation; the first exact
+            # recompute has no meaningful baseline -> keep drift
+            mixed = ((1.0 - cfg.drift_ema) * state.drift
+                     + cfg.drift_ema * per_step)
+            drift_new = jnp.where(base_lt > 0, mixed, state.drift)
+            return lt_new, drift_new.astype(grad.dtype), lt_new
 
-    lt, drift, last_exact_lt = lax.cond(recompute_local, lt_exact,
-                                        lt_predicted)
+        def lt_predicted():
+            return prev_lt * state.drift, state.drift, state.last_exact_lt
 
-    # ---- region repartition every repartition_every steps (reference :626-654).
-    boundaries = lax.cond(
-        (state.step % cfg.repartition_every == 0) | first_sparse,
-        lambda: _repartition(abs_acc, lt, cfg, axis_name),
-        lambda: state.boundaries)
+        lt, drift, last_exact_lt = lax.cond(recompute_local, lt_exact,
+                                            lt_predicted)
 
     # ---- phase (a): select, exchange to region owners, scatter-add reduce.
-    up = bool(cfg.use_pallas)
-    mask = abs_acc >= lt
-    local_count = jnp.sum(mask)
-    s_vals, s_idx, s_counts = pack_by_region(
-        acc, mask, boundaries, P, cfg.cap_pair, thresh=lt, use_pallas=up)
+    # Region repartition every repartition_every steps (reference
+    # :626-654); the fused kernel is region-blind (regions are assigned in
+    # its cap-scale finalize), so on fused steps the boundaries can be
+    # computed from the kernel's own acc output in between stage and
+    # finalize — repartition's extra |acc| sweep prices only its cadence.
+    repart = (state.step % cfg.repartition_every == 0) | first_sparse
+    if fuse:
+        st = fused_select_stage(grad, state.residual, lt,
+                                lt * cfg.probe_ratio)
+        acc = st.acc
+        boundaries = lax.cond(
+            repart,
+            lambda: _repartition(jnp.abs(acc), lt, cfg, axis_name),
+            lambda: state.boundaries)
+        s_vals, s_idx, s_counts = fused_pack_finalize(
+            st, boundaries, P, cfg.cap_pair)
+        local_count = st.local_count
+        local_probe = st.probe_count
+        hist = st.hist
+        # only the bf16 wire's residual path reads the sent mask; it fuses
+        # into the single consumer pass over acc at the bottom (and is
+        # DCE'd entirely under the f32 wire). The kernel's own staging
+        # mask clamps the threshold to min-normal f32 (ops/compaction.py
+        # _prep) — identical whenever lt is normal, i.e. every step after
+        # the first exact recompute.
+        mask = jnp.abs(acc) >= lt
+    else:
+        boundaries = lax.cond(
+            repart,
+            lambda: _repartition(abs_acc, lt, cfg, axis_name),
+            lambda: state.boundaries)
+        mask = abs_acc >= lt
+        local_count = jnp.sum(mask)
+        s_vals, s_idx, s_counts = pack_by_region(
+            acc, mask, boundaries, P, cfg.cap_pair, thresh=lt,
+            use_pallas=up)
+        # threshold feedback probe (fuses into the same pass over abs_acc)
+        local_probe = jnp.sum(abs_acc >= lt * cfg.probe_ratio)
+        # "hist" standalone pays its one histogram pass lazily, inside the
+        # recompute cond below (the fused kernel emits it for free)
+        hist = None
     r_vals = all_to_all(_on_wire(s_vals, cfg, state.step), axis_name) \
         .astype(acc.dtype)                     # [P, cap_pair]
     r_idx = all_to_all(s_idx, axis_name)
@@ -211,11 +287,37 @@ def oktopk(grad: jnp.ndarray, state: SparseState, cfg: OkTopkConfig,
     own_count = s_counts[rank]
     vol_a = 2.0 * (sent_count - own_count) + 2.0 * (recv_count - own_count)
 
-    # threshold feedback for the next step (the probe count fuses into the
-    # same pass over abs_acc)
-    local_probe = jnp.sum(abs_acc >= lt * cfg.probe_ratio)
-    lt_next = _newton_adapt(lt, local_count, local_probe, k, cfg,
-                            target=_target_k(k, n, cfg.local_k_target))
+    # ---- local threshold feedback for the next step
+    if hist_mode:
+        def lt_measured():
+            # lagged exact recompute: adopt the k-th-value level read from
+            # this step's histogram, and re-measure the drift rate against
+            # the previous exact level (same machinery as lt_exact above).
+            # Unfused steps build the histogram here, inside the branch —
+            # integer counts, bit-identical to the kernel's
+            h = hist if hist is not None else log2_hist(acc)
+            lt_new = hist_to_threshold(h, tkl).astype(grad.dtype)
+            gap = max(1, cfg.local_recompute_every)
+            base_lt = state.last_exact_lt
+            ratio = jnp.where((lt_new > 0) & (base_lt > 0),
+                              lt_new / jnp.maximum(base_lt, 1e-30), 1.0)
+            per_step = jnp.clip(ratio ** (1.0 / gap),
+                                cfg.drift_clip_lo, cfg.drift_clip_hi)
+            mixed = ((1.0 - cfg.drift_ema) * state.drift
+                     + cfg.drift_ema * per_step)
+            drift_new = jnp.where(base_lt > 0, mixed, state.drift)
+            return lt_new, drift_new.astype(grad.dtype), lt_new
+
+        def lt_adapted():
+            return (_newton_adapt(lt, local_count, local_probe, k, cfg,
+                                  target=tkl),
+                    state.drift, state.last_exact_lt)
+
+        lt_next, drift, last_exact_lt = lax.cond(recompute_local,
+                                                 lt_measured, lt_adapted)
+    else:
+        lt_next = _newton_adapt(lt, local_count, local_probe, k, cfg,
+                                target=tkl)
 
     # ---- phase (b): global winner selection + allgather.
     cap_g = cfg.cap_gather
@@ -252,7 +354,11 @@ def oktopk(grad: jnp.ndarray, state: SparseState, cfg: OkTopkConfig,
                                 cfg.threshold_method,
                                 cfg.bisect_iters).astype(acc.dtype)
         keep = (jnp.abs(gv) >= gt) & (gi < n)
-        result = scatter_sparse(n, jnp.where(keep, gv, 0.0),
+        # values pre-divided by P at cap scale: every gathered index is
+        # unique (regions are disjoint and each worker's winners are
+        # deduplicated), so scatter(gv / P) == scatter(gv) / P bit-for-bit
+        # — and the old dense n-scale division pass disappears
+        result = scatter_sparse(n, jnp.where(keep, gv, 0.0) / P,
                                 jnp.where(keep, gi, n))
         g_count = jnp.sum(keep)
         total_c = psum(cand_count, axis_name)
@@ -273,7 +379,7 @@ def oktopk(grad: jnp.ndarray, state: SparseState, cfg: OkTopkConfig,
         gv = all_gather(_on_wire(gvals, cfg, state.step), axis_name) \
             .astype(acc.dtype)                         # [P, cap_g]
         gi = all_gather(gidx, axis_name)
-        result = scatter_sparse(n, gv, gi)
+        result = scatter_sparse(n, gv / P, gi)  # pre-divided (see exact_branch)
         # Newton probe count rides the same psum as the realised count —
         # one 2-vector allreduce (the reference pays a full size-exchange
         # Allgather for less information, VGG/allreducer.py:807)
@@ -291,11 +397,13 @@ def oktopk(grad: jnp.ndarray, state: SparseState, cfg: OkTopkConfig,
     result, gt_next, g_count, vol_b = lax.cond(
         recompute_global, exact_branch, predicted_branch)
 
-    result = result / P
-
     # ---- residual: zero only at indices that made the global result
     # (reference VGG/allreducer.py:1051-1052); under the bf16 wire the
     # rounding errors stay in the residual (collectives/wire.py).
+    # With the phase-(b) values pre-divided at cap scale, the old
+    # result/P + winner_mask + residual trio collapses into ONE consumer
+    # pass over (result, acc, reduced) — the last n-scale sweep of the
+    # step (docs/PERF.md "selection hot path").
     winner_mask = result != 0.0
     residual = residual_after_winners(acc, winner_mask, mask, reduced, cfg)
 

@@ -560,7 +560,15 @@ def _pack_by_region_pallas(x, thresh, boundaries, num_regions: int,
 
     w_f, stored_f, raw = _run_stage(xp, t, rng, CAPB_FAST, nblocks,
                                     interpret, vma)
+    return _pack_finalize(xp, xflat, t, rng, bnd, R, cap, nblocks, n,
+                          interpret, vma, w_f, stored_f, raw)
 
+
+def _pack_finalize(xp, xflat, t, rng, bnd, R, cap, nblocks, n, interpret,
+                   vma, w_f, stored_f, raw):
+    """Cap-scale region post-processing shared by ``pack_by_region_pallas``
+    and the fused selection front-end (ops/fused_select.py): overflow
+    census -> fast/repair/wide dispatch over already-staged fast rows."""
     # Region reconstruction requires every survivor staged (fast rows when
     # nothing overflowed, repaired rows for the <= ncap overflow blocks,
     # or the capb=BLK kernel otherwise). _region_counts is nb-scale — the

@@ -1,0 +1,284 @@
+"""Fused selection front-end: residual add + select + stage in ONE sweep.
+
+The steady-state oktopk step front-end used to make ~6 separate n-scale
+HBM sweeps over the gradient: ``add_residual`` (read grad + residual, write
+acc), ``jnp.abs`` (read acc), the threshold mask + realised count (read),
+the Newton probe count (read), and the staging pass of
+``ops/compaction.py`` (read). This module's kernel makes ONE: it reads
+(grad, residual) block by block, computes ``acc = grad + residual``
+in-register, and emits in the same grid step
+
+- the acc block itself (the only n-scale write; every later consumer —
+  repartition, the residual update — reads this buffer),
+- the compaction staging rows + raw per-block survivor counts of
+  ``ops/compaction.py`` (same layout, bit-identical — the cap-scale
+  post-processing ``_pack_finalize`` is shared),
+- the per-block Newton probe counts (``|acc| >= thresh * probe_ratio``,
+  previously a separate sweep in collectives/oktopk.py),
+- a 256-bin log2-magnitude histogram partial (ops/hist_threshold.py bins,
+  bit-identical to ``log2_hist``) — which makes the "hist" exact threshold
+  recompute ZERO extra passes on fused steps.
+
+Steady-state sweeps over n after this module: the fused pass (2 reads +
+1 write), the phase-(a) scatter, and the single consumer pass (result
+scale + winner mask + residual) — see docs/PERF.md.
+
+The staging mask uses the min-normal-clamped threshold exactly as
+``_prep`` does; the probe count deliberately uses the UNCLAMPED probe
+threshold so it is bit-identical to the portable
+``jnp.sum(abs_acc >= lt * probe_ratio)`` (which has no clamp). The
+histogram covers nonzero in-range elements only, so the zero padding the
+kernel adds never shows up in any output.
+
+All outputs reproduce the portable path bit-for-bit in interpret mode
+(tests/test_fused_select.py, same contract as ops/compaction.py);
+tests/test_tpu_hw.py mirrors them for real-chip Mosaic compilation.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from oktopk_tpu.comm import compat
+from oktopk_tpu.ops.compaction import (
+    BLK,
+    BLK_COLS,
+    BLK_ROWS,
+    CAPB_FAST,
+    SB,
+    _block_prefix,
+    _interpret_default,
+    _pack_finalize,
+    _pvary_to,
+    _stage_tile,
+    _vma_of,
+)
+from oktopk_tpu.ops.hist_threshold import HIST_BINS, log2_bins, log2_hist
+
+
+def _fused_kernel(capb, t_ref, tp_ref, r_ref, g_ref, res_ref,
+                  acc_ref, w_ref, cr_ref, pr_ref, h_ref):
+    """Stage SB consecutive blocks of acc = grad + residual in one sweep.
+
+    Outputs per grid step: the acc tile, the staging rows + raw counts of
+    ``_stage_kernel`` (identical layout), per-block probe counts, and a
+    [SB, HIST_BINS] histogram accumulator (constant index_map: the block
+    stays resident in VMEM across grid steps and row sb accumulates
+    sub-block sb — the standard reduction-output pattern). Counts are f32
+    (MXU one-hot matmuls); each accumulator cell is bounded by n/SB, exact
+    in f32 for n up to 2^24 * SB = 134M elements.
+    """
+    import jax.experimental.pallas as pl
+
+    i = pl.program_id(0)
+    acc = g_ref[:] + res_ref[:]                           # [SB*8, 128] f32
+    acc_ref[:] = acc
+    woff = (jax.lax.broadcasted_iota(jnp.int32, (BLK_ROWS, BLK_COLS), 0)
+            * BLK_COLS
+            + jax.lax.broadcasted_iota(jnp.int32, (BLK_ROWS, BLK_COLS), 1))
+
+    @pl.when(i == 0)
+    def _():
+        h_ref[:] = jnp.zeros_like(h_ref)
+
+    rows_w, rows_r, rows_p, rows_h = [], [], [], []
+    for sb in range(SB):
+        x = jax.lax.slice(acc, (sb * BLK_ROWS, 0),
+                          ((sb + 1) * BLK_ROWS, BLK_COLS))
+        ax = jnp.abs(x)
+        gidx = (i * SB + sb) * BLK + woff
+        inr = (gidx >= r_ref[0]) & (gidx < r_ref[1])
+        mask = (ax >= t_ref[0]) & inr
+        m = mask.astype(jnp.int32)
+        pos, raw = _block_prefix(m)
+
+        kept = mask & (pos < capb)
+        sel = jnp.where(kept, pos, capb)                  # capb = dropped
+        rows_w.append(_stage_tile(jnp.where(kept, woff, 0), sel, capb))
+        rows_r.append(jnp.full((1, BLK_COLS), raw, jnp.int32))
+
+        # Newton probe: unclamped threshold (bit-parity with the portable
+        # jnp.sum(abs_acc >= lt * probe_ratio)), range-masked so padding
+        # never counts even when the probe threshold is 0
+        probe = jnp.sum(((ax >= tp_ref[0]) & inr).astype(jnp.int32))
+        rows_p.append(jnp.full((1, BLK_COLS), probe, jnp.int32))
+
+        # log2-magnitude histogram of live in-range elements: same one-hot
+        # NT matmul as the staging rows, with collisions doing the counting
+        bins = log2_bins(x)                               # -1 marks zeros
+        live = (bins >= 0) & inr
+        rows_h.append(_stage_tile(live.astype(jnp.int32),
+                                  jnp.maximum(bins, 0), HIST_BINS))
+    w_ref[:] = jnp.concatenate(rows_w, axis=0)
+    cr_ref[:] = jnp.concatenate(rows_r, axis=0)
+    pr_ref[:] = jnp.concatenate(rows_p, axis=0)
+    h_ref[:] = h_ref[:] + jnp.concatenate(rows_h, axis=0)
+
+
+def _run_fused_stage(gp, rp, t, tp, rng, capb, nblocks, interpret, vma):
+    """pallas_call wrapper: (acc_p [nb*8, 128], w_stage [nb, capb],
+    stored [nb], raw [nb], probe [nb], hist [HIST_BINS])."""
+    import jax.experimental.pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    out_shapes = [
+        compat.shape_dtype_struct((nblocks * BLK_ROWS, BLK_COLS),
+                                  jnp.float32, vma=vma),
+        compat.shape_dtype_struct((nblocks, capb), jnp.float32, vma=vma),
+        compat.shape_dtype_struct((nblocks, BLK_COLS), jnp.int32, vma=vma),
+        compat.shape_dtype_struct((nblocks, BLK_COLS), jnp.int32, vma=vma),
+        compat.shape_dtype_struct((SB, HIST_BINS), jnp.float32, vma=vma),
+    ]
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,
+        grid=(nblocks // SB,),
+        in_specs=[
+            pl.BlockSpec((SB * BLK_ROWS, BLK_COLS),
+                         lambda i, t, tp, r: (i, 0)),
+            pl.BlockSpec((SB * BLK_ROWS, BLK_COLS),
+                         lambda i, t, tp, r: (i, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((SB * BLK_ROWS, BLK_COLS),
+                         lambda i, t, tp, r: (i, 0)),
+            pl.BlockSpec((SB, capb), lambda i, t, tp, r: (i, 0)),
+            pl.BlockSpec((SB, BLK_COLS), lambda i, t, tp, r: (i, 0)),
+            pl.BlockSpec((SB, BLK_COLS), lambda i, t, tp, r: (i, 0)),
+            pl.BlockSpec((SB, HIST_BINS), lambda i, t, tp, r: (0, 0)),
+        ],
+    )
+    acc_p, w, cr, pr, h = pl.pallas_call(
+        functools.partial(_fused_kernel, capb),
+        grid_spec=grid_spec,
+        out_shape=out_shapes,
+        interpret=interpret,
+    )(t, tp, rng, gp, rp)
+    raw = cr[:, 0]
+    hist = jnp.sum(h, axis=0).astype(jnp.int32)
+    return acc_p, w, jnp.minimum(raw, capb), raw, pr[:, 0], hist
+
+
+class FusedStage(NamedTuple):
+    """Single-sweep front-end outputs plus the staging internals the
+    region finalisation (``fused_pack_finalize``) consumes."""
+    acc: jnp.ndarray           # [n] f32 — grad + residual
+    local_count: jnp.ndarray   # i32 — realised count(|acc| >= thresh)
+    probe_count: jnp.ndarray   # i32 — count(|acc| >= probe_thresh)
+    hist: jnp.ndarray          # [HIST_BINS] i32 — log2_hist(acc)
+    # staging internals (padded layout)
+    accp: jnp.ndarray          # [nb*8, 128] padded acc tiles
+    accflat: jnp.ndarray       # [nb*8*128] padded acc flat
+    w_f: jnp.ndarray           # [nb, CAPB_FAST] fast staging rows
+    stored_f: jnp.ndarray      # [nb] min(raw, CAPB_FAST)
+    raw: jnp.ndarray           # [nb] raw per-block survivor counts
+    t: jnp.ndarray             # [1] clamped staging threshold
+    rng: jnp.ndarray           # [2] element range [0, n)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def fused_select_stage(grad: jnp.ndarray, residual: jnp.ndarray, thresh,
+                       probe_thresh, interpret: bool | None = None
+                       ) -> FusedStage:
+    """Run the fused kernel over (grad, residual): one sweep computes acc,
+    the fast staging rows, the realised/probe counts and the histogram.
+
+    The staging threshold is min-normal-clamped exactly as
+    ``select_by_threshold_pallas`` (``_prep``); ``probe_thresh`` is used
+    unclamped (see module docstring). Region assembly is a separate
+    cap-scale step (``fused_pack_finalize``) so the caller can compute
+    data-dependent boundaries from ``acc`` in between (the repartition
+    cadence of collectives/oktopk.py).
+    """
+    if interpret is None:
+        interpret = _interpret_default()
+    if grad.shape != residual.shape:
+        raise ValueError(f"grad {grad.shape} != residual {residual.shape}")
+    n = grad.size
+    pad = (-n) % (SB * BLK)
+    gp = jnp.pad(grad.reshape(-1), (0, pad)).reshape(-1, BLK_COLS)
+    rp = jnp.pad(residual.reshape(-1), (0, pad)).reshape(-1, BLK_COLS)
+    nblocks = gp.shape[0] // BLK_ROWS
+    t = jnp.reshape(jnp.maximum(jnp.asarray(thresh, grad.dtype),
+                                jnp.float32(1.17549435e-38)), (1,))
+    tp = jnp.reshape(jnp.asarray(probe_thresh, grad.dtype), (1,))
+    rng = jnp.stack([jnp.asarray(0, jnp.int32), jnp.asarray(n, jnp.int32)])
+    vma = _vma_of(gp)
+    if vma:
+        t = _pvary_to(t, vma)
+        tp = _pvary_to(tp, vma)
+        rng = _pvary_to(rng, vma)
+
+    accp, w_f, stored_f, raw, probe_blk, hist = _run_fused_stage(
+        gp, rp, t, tp, rng, CAPB_FAST, nblocks, interpret, vma)
+    accflat = accp.reshape(-1)
+    return FusedStage(
+        acc=accflat[:n], local_count=jnp.sum(raw),
+        probe_count=jnp.sum(probe_blk), hist=hist,
+        accp=accp, accflat=accflat, w_f=w_f, stored_f=stored_f, raw=raw,
+        t=t, rng=rng)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("num_regions", "cap", "interpret"))
+def fused_pack_finalize(st: FusedStage, boundaries, num_regions: int,
+                        cap: int, interpret: bool | None = None):
+    """Per-region (values, indices, counts) from an already-run fused
+    stage — the cap-scale half of ``pack_by_region_pallas``, shared
+    verbatim (``_pack_finalize``): overflowing blocks are re-staged from
+    the kernel's own acc output by the repair/wide kernels, so overflow
+    costs extra passes only when it happens, exactly as before."""
+    if interpret is None:
+        interpret = _interpret_default()
+    n = st.acc.size
+    nblocks = st.w_f.shape[0]
+    bnd = jnp.asarray(boundaries, jnp.int32)
+    vma = _vma_of(st.accp)
+    return _pack_finalize(st.accp, st.accflat, st.t, st.rng, bnd,
+                          num_regions, cap, nblocks, n, interpret, vma,
+                          st.w_f, st.stored_f, st.raw)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("num_regions", "cap", "interpret"))
+def fused_select_pallas(grad: jnp.ndarray, residual: jnp.ndarray, thresh,
+                        probe_thresh, boundaries, num_regions: int,
+                        cap: int, interpret: bool | None = None):
+    """One-call form (unit tests / profiling): stage + finalize.
+
+    Returns ``(acc, values [R, cap], indices [R, cap], counts [R],
+    local_count, probe_count, hist [HIST_BINS])`` — bit-identical to
+    :func:`fused_select_reference`.
+    """
+    st = fused_select_stage(grad, residual, thresh, probe_thresh,
+                            interpret=interpret)
+    values, indices, counts = fused_pack_finalize(
+        st, boundaries, num_regions, cap, interpret=interpret)
+    return (st.acc, values, indices, counts, st.local_count,
+            st.probe_count, st.hist)
+
+
+def fused_select_reference(grad: jnp.ndarray, residual: jnp.ndarray,
+                           thresh, probe_thresh, boundaries,
+                           num_regions: int, cap: int):
+    """Portable semantics twin (the parity oracle, and the CPU profile
+    probe): the same outputs from the separate portable sweeps. The
+    selection mask uses the min-normal-clamped threshold (as the kernel
+    and ``pack_by_region_pallas`` do); the probe count uses the raw one
+    (as collectives/oktopk.py always has)."""
+    from oktopk_tpu.ops.select import pack_by_region
+
+    acc = grad.reshape(-1) + residual.reshape(-1)
+    t = jnp.maximum(jnp.asarray(thresh, acc.dtype),
+                    jnp.float32(1.17549435e-38))
+    abs_acc = jnp.abs(acc)
+    mask = abs_acc >= t
+    values, indices, counts = pack_by_region(
+        acc, mask, jnp.asarray(boundaries, jnp.int32), num_regions, cap)
+    local_count = jnp.sum(mask)
+    probe_count = jnp.sum(abs_acc >= jnp.asarray(probe_thresh, acc.dtype))
+    return (acc, values, indices, counts, local_count, probe_count,
+            log2_hist(acc))

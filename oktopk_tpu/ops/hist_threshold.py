@@ -1,0 +1,106 @@
+"""One-pass histogram selection thresholds (the fused-path recompute).
+
+``k2threshold_bisect`` (ops/pallas_topk.py) narrows a log-space bracket
+3 bits per memory pass — ~10 n-scale HBM sweeps per exact recompute at the
+default ``bisect_iters=30``, plus the max|x| anchor pass. This module reads
+the k-th-value threshold off a 256-bin log2-magnitude histogram instead:
+
+- ``log2_hist``: ONE pass over the data builds the histogram. Bins are the
+  f32 *biased exponent* (bits 30..23), one bin per binary octave, covering
+  the entire normal-f32 range with no data-dependent anchor — which is what
+  lets the fused selection kernel (ops/fused_select.py) emit the same
+  histogram as a byproduct of its single sweep, making the exact recompute
+  ZERO extra passes on fused steps and one pass standalone.
+- ``hist_to_threshold``: the cumsum read (256-scalar work, no data pass).
+
+Bracket-floor semantics and the min-normal clamp are preserved from the
+bisection (the absorbing-zero lesson, ops/pallas_topk.py): the returned
+threshold is the largest bin lower edge with count(|x| >= edge) >= k, always
+a normal power of two >= 2^-126, and exactly 0 only when the input is all
+zero. Within-octave resolution is 1 bit (t in (kth/2, kth]) versus the
+bisection's ~2^-30 — "bisect" stays the oracle and the default; "hist" is
+the fused fast path (OkTopkConfig.threshold_method).
+
+Subnormal inputs (CPU only; TPU flushes them to zero) are binned at the
+min-normal edge, matching the selection kernel's own threshold clamp
+(ops/compaction.py ``_prep``): a threshold of 2^-126 selects exactly the
+nonzeros on TPU.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+HIST_BINS = 256
+
+# f32 exponent bias; bin j (1 <= j <= 254) counts 2^(j-127) <= |x| < 2^(j-126)
+_BIAS = 127
+_MAX_EDGE_BIN = 254   # bin 255 holds inf/nan; its edge (2^128) is not f32
+
+
+def log2_bins(x: jnp.ndarray) -> jnp.ndarray:
+    """Per-element histogram bin: the f32 biased exponent of |x|, with
+    subnormals promoted to bin 1 (the min-normal edge) and exact zeros
+    marked -1 (excluded from the histogram).
+
+    Bit extraction, not ``floor(log2(x))``: the float log is inexact at
+    octave boundaries (log2(2^-10) can round below -10) and the fused
+    kernel must reproduce these bins bit-for-bit (ops/fused_select.py).
+    """
+    bits = lax.bitcast_convert_type(x.astype(jnp.float32), jnp.int32)
+    mag = bits & jnp.int32(0x7FFFFFFF)
+    e = jnp.right_shift(mag, 23)
+    return jnp.where(mag == 0, jnp.int32(-1),
+                     jnp.maximum(e, jnp.int32(1)))
+
+
+def log2_hist(x: jnp.ndarray) -> jnp.ndarray:
+    """[HIST_BINS] i32 counts of the nonzero elements of ``x`` by binary
+    octave (``log2_bins``), in ONE pass over the data.
+
+    Standalone form is a scatter-add (zeros parked in a spilled 257th bin
+    so no index is ever out of range or negative). An n-operand scatter
+    serialises on TPU (ops/compaction.py module docstring) — but on the
+    TPU fast path this function never runs per-step: the fused selection
+    kernel emits the identical histogram via MXU one-hot accumulation
+    (ops/fused_select.py), and the oktopk "hist" controller only calls
+    the standalone form inside its recompute/priming cond branches.
+    Counts are integers, so both constructions agree bit-for-bit.
+    """
+    b = log2_bins(x).reshape(-1)
+    b = jnp.where(b < 0, jnp.int32(HIST_BINS), b)
+    h = jnp.zeros(HIST_BINS + 1, jnp.int32).at[b].add(1)
+    return h[:HIST_BINS]
+
+
+def hist_to_threshold(hist: jnp.ndarray, k) -> jnp.ndarray:
+    """k-th-value threshold from a ``log2_hist`` histogram: the largest bin
+    lower edge 2^(j-127) whose suffix count is >= k (bracket floor), j
+    clamped to [1, 254] so the result is always a normal f32 (min-normal
+    clamp; the absorbing-zero lesson). Exactly 0 only for an empty
+    histogram (all-zero input). ``k`` may be traced (a scheduled target).
+
+    When fewer than k elements are live the floor degenerates to the
+    min-normal edge — like the bisection's positive bracket floor, this
+    selects exactly the live elements, never everything.
+    """
+    hist = hist.astype(jnp.int32)
+    cum = jnp.cumsum(hist[::-1])[::-1]          # cum[j] = count(bin >= j)
+    j = jnp.arange(HIST_BINS, dtype=jnp.int32)
+    ok = (cum >= k) & (j >= 1) & (j <= _MAX_EDGE_BIN)
+    jstar = jnp.max(jnp.where(ok, j, jnp.int32(1)))
+    # assemble 2^(jstar-127) from the exponent bits directly: jnp.exp2 is
+    # not trustworthy at the normal-range floor (XLA's f32 exp2 flushes
+    # exp2(-126) to 0 on some backends — exactly the absorbing zero this
+    # function must never produce)
+    t = lax.bitcast_convert_type(jnp.left_shift(jstar, 23), jnp.float32)
+    return jnp.where(cum[0] > 0, t, jnp.float32(0.0))
+
+
+def k2threshold_hist(x_abs: jnp.ndarray, k) -> jnp.ndarray:
+    """Standalone one-pass form: histogram + cumsum read. Same contract as
+    ``k2threshold_bisect`` up to the 1-bit bin resolution: the result t
+    satisfies count(|x| >= t) >= k and kth/2 < t <= kth whenever at least
+    k elements are live."""
+    return hist_to_threshold(log2_hist(x_abs), k).astype(x_abs.dtype)

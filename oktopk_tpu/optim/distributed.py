@@ -272,7 +272,13 @@ def build_sparse_grad_step(
         vol = lk = gk = wbytes = jnp.asarray(0.0, jnp.float32)
         eps_num = eps_den = jnp.asarray(0.0, jnp.float32)
         for bi, idxs in enumerate(buckets):
-            flat = jnp.concatenate([leaves[i].reshape(-1) for i in idxs])
+            # copy-free single-leaf bucket: reshape is a view under XLA,
+            # while a 1-element concatenate still materialises a second
+            # n-length buffer (and the matching slice-back below a third)
+            if len(idxs) == 1:
+                flat = leaves[idxs[0]].reshape(-1)
+            else:
+                flat = jnp.concatenate([leaves[i].reshape(-1) for i in idxs])
             over = {}
             if not single:
                 over["n"] = int(flat.size)
@@ -296,11 +302,15 @@ def build_sparse_grad_step(
             if guard is not None:
                 bad_counts.append(
                     _guard_mod.local_anomaly_count(flat, reduced, guard))
-            off = 0
-            for i in idxs:
-                sz = leaves[i].size
-                results[i] = reduced[off:off + sz].reshape(leaves[i].shape)
-                off += sz
+            if len(idxs) == 1:
+                results[idxs[0]] = reduced.reshape(leaves[idxs[0]].shape)
+            else:
+                off = 0
+                for i in idxs:
+                    sz = leaves[i].size
+                    results[i] = reduced[off:off + sz] \
+                        .reshape(leaves[i].shape)
+                    off += sz
             sp_olds.append(sp)
             sp_news.append(sp_new)
             vol = vol + sp_new.last_volume

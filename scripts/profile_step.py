@@ -6,20 +6,28 @@ VGG/allreducer.py:256-262,379-439). Under XLA the phases fuse into one
 compiled program, so the breakdown comes from timing *separately compiled*
 subprograms on the same data instead:
 
-  fwd_bwd   — loss + gradient only (the pure model compute path)
-  select    — the full sparse allreduce on a same-sized flat gradient
-              (threshold + pack + exchange + gather + scatter)
-  threshold — just the exact k-th-value recompute (count-bisection)
-  pack      — just the fixed-capacity selection/compaction
-  full      — the actual fused train step (what bench.py times)
+  fwd_bwd      — loss + gradient only (the pure model compute path)
+  select       — the full sparse allreduce on a same-sized flat gradient
+                 (threshold + pack + exchange + gather + scatter)
+  select_hist  — the same allreduce under threshold_method="hist" (the
+                 one-pass lagged recompute; ops/hist_threshold.py)
+  threshold    — just the exact k-th-value recompute (count-bisection)
+  hist         — just the one-pass histogram threshold (standalone form)
+  fused_select — the single-sweep selection front-end of
+                 ops/fused_select.py (portable reference twin on CPU —
+                 the interpreter at real n takes minutes — the Pallas
+                 kernel on TPU), vs its separate-pass equivalent `pack`
+  pack         — just the fixed-capacity selection/compaction
+  full         — the actual fused train step (what bench.py times)
 
 full < fwd_bwd + select is expected (XLA overlaps/fuses); a full that is
 dominated by `select`'s components reproduces the round-2 diagnosis
 (selection-bound step), and the Pallas-vs-portable delta is read directly
 off `pack`.
 
-Writes one JSON line; run on the real chip for BENCH profile notes, or on
-CPU for smoke. Usage:  python scripts/profile_step.py [--iters 10]
+Writes one JSON line (also to --json PATH for obs/regress.py baselines);
+run on the real chip for BENCH profile notes, or on CPU for smoke.
+Usage:  python scripts/profile_step.py [--iters 10] [--json out.json]
 """
 
 from __future__ import annotations
@@ -57,6 +65,9 @@ def main():
                     help="jax platform override (e.g. cpu) — env vars alone "
                          "cannot undo the site plugin's backend selection "
                          "(see tests/conftest.py)")
+    ap.add_argument("--json", default=None, metavar="PATH",
+                    help="also write the profile dict to PATH as JSON "
+                         "(machine-readable; feedable to obs/regress.py)")
     args = ap.parse_args()
 
     import jax
@@ -71,6 +82,11 @@ def main():
     from oktopk_tpu.config import OkTopkConfig, TrainConfig
     from oktopk_tpu.data.synthetic import synthetic_batch
     from oktopk_tpu.ops.compaction import resolve_use_pallas
+    from oktopk_tpu.ops.fused_select import (
+        fused_select_pallas,
+        fused_select_reference,
+    )
+    from oktopk_tpu.ops.hist_threshold import k2threshold_hist
     from oktopk_tpu.ops.select import select_by_threshold
     from oktopk_tpu.ops.topk import k2threshold_method
     from oktopk_tpu.train.trainer import Trainer
@@ -106,11 +122,35 @@ def main():
     out["use_pallas"] = bool(acfg.use_pallas)
     step = build_allreduce_step("oktopk", acfg, mesh, warmup=False)
     g = jax.device_put(jnp.asarray(rng.randn(1, n).astype(np.float32)))
-    state = batched_init_state(acfg)
-    _, state = step(g, state)                 # compile + enter steady state
+
+    # The timed loop re-uses one state, freezing the step counter — pin it
+    # to an exact-recompute step (the branch where the threshold methods
+    # actually differ; predicted steps execute identical programs). A
+    # profile loop that re-used one state at step 1 would only ever time
+    # the predicted branch. This is also why the step builder's
+    # donate_state stays off here: a donated state is consumed by the
+    # first timed call.
+    import dataclasses
+
+    def _steady(cfg_):
+        st0 = batched_init_state(cfg_)
+        _, st = step_fns[cfg_.threshold_method](g, st0)
+        pin = jnp.zeros_like(st.step) + cfg_.local_recompute_every
+        return dataclasses.replace(st, step=pin)
+
+    hcfg = acfg.replace(threshold_method="hist")
+    step_fns = {acfg.threshold_method: step,
+                "hist": build_allreduce_step("oktopk", hcfg, mesh,
+                                             warmup=False)}
+    state = _steady(acfg)
     out["select_ms"] = _med_ms(lambda: step(g, state)[0], sync, args.iters)
 
-    # --- components: exact threshold, and the capacity pack
+    # --- the same allreduce under the one-pass histogram threshold
+    hstate = _steady(hcfg)
+    out["select_hist_ms"] = _med_ms(
+        lambda: step_fns["hist"](g, hstate)[0], sync, args.iters)
+
+    # --- components: exact threshold (bisect + hist), and the pack
     k = acfg.k
     gf = g[0]
     thr_fn = jax.jit(lambda x: k2threshold_method(jnp.abs(x), k,
@@ -120,14 +160,42 @@ def main():
     out["threshold_ms"] = _med_ms(lambda: thr_fn(gf), sync, args.iters)
     t = thr_fn(gf)
 
+    hist_fn = jax.jit(lambda x: k2threshold_hist(jnp.abs(x), k))
+    sync(hist_fn(gf))
+    out["hist_ms"] = _med_ms(lambda: hist_fn(gf), sync, args.iters)
+
     pk = jax.jit(lambda x: select_by_threshold(
         x, t, acfg.cap_gather, use_pallas=bool(acfg.use_pallas)))
     sync(pk(gf))
     out["pack_ms"] = _med_ms(lambda: pk(gf), sync, args.iters)
 
+    # --- the fused single-sweep front-end (acc + stage + counts + hist).
+    # The Pallas interpreter at real n is minutes-slow, so off-TPU the
+    # probe times the portable semantics twin — the XLA-fused equivalent
+    # of the separate passes it replaces; the kernel itself is timed on
+    # the chip (dev.platform in {"tpu", "axon"}).
+    res = jax.device_put(jnp.zeros_like(gf))
+    bnd = jnp.asarray([0, n], jnp.int32)
+    tp = t * acfg.probe_ratio
+    if dev.platform in ("tpu", "axon"):
+        fs = jax.jit(lambda x, r: fused_select_pallas(
+            x, r, t, tp, bnd, 1, acfg.cap_pair, interpret=False))
+        out["fused_select_backend"] = "pallas"
+    else:
+        fs = jax.jit(lambda x, r: fused_select_reference(
+            x, r, t, tp, bnd, 1, acfg.cap_pair))
+        out["fused_select_backend"] = "reference"
+    sync(fs(gf, res))
+    out["fused_select_ms"] = _med_ms(lambda: fs(gf, res), sync, args.iters)
+    out["threshold_method"] = acfg.threshold_method
+
     out = {k2: (round(v, 3) if isinstance(v, float) else v)
            for k2, v in out.items()}
     print("PROFILE " + json.dumps(out))
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(out, f, indent=2)
+            f.write("\n")
 
 
 if __name__ == "__main__":

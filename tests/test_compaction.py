@@ -15,6 +15,10 @@ import jax.numpy as jnp
 from oktopk_tpu.ops.compaction import BLK, select_by_threshold_pallas
 from oktopk_tpu.ops.select import select_by_threshold
 
+# `pytest -m kernels` runs the Pallas parity suites standalone during
+# kernel iteration (pytest.ini)
+pytestmark = pytest.mark.kernels
+
 
 def run_both(x, thresh, cap):
     got = select_by_threshold_pallas(jnp.asarray(x), thresh, cap,

@@ -1,0 +1,151 @@
+"""Histogram threshold semantics (ops/hist_threshold.py).
+
+The log-space absorbing-zero regression pinned for the bisection
+(tests elsewhere; ops/pallas_topk.py docstring) must hold here too: a
+threshold of exactly 0 absorbs the multiplicative Newton controller
+(0 * anything == 0), so the histogram read may return 0 ONLY for an
+all-zero input, and must resolve thresholds across the full normal-f32
+dynamic range without a data-dependent anchor.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from oktopk_tpu.ops.hist_threshold import (
+    HIST_BINS,
+    hist_to_threshold,
+    k2threshold_hist,
+    log2_bins,
+    log2_hist,
+)
+
+MIN_NORMAL = np.float32(1.17549435e-38)
+
+
+class TestBins:
+    def test_bins_are_biased_exponents(self):
+        x = jnp.asarray([1.0, 2.0, 0.5, 1.5, 3.9999], jnp.float32)
+        np.testing.assert_array_equal(np.asarray(log2_bins(x)),
+                                      [127, 128, 126, 127, 128])
+
+    def test_octave_boundaries_exact(self):
+        # bit extraction (not float log2): 2^e sits in bin e+127 exactly,
+        # nextafter below it one bin down — no rounding at the edges
+        for e in (-126, -60, -10, 0, 10, 100, 127):
+            v = np.float32(2.0 ** e)
+            below = np.nextafter(v, 0, dtype=np.float32)
+            assert int(log2_bins(jnp.asarray([v]))[0]) == e + 127
+            if below > 0 and e > -126:
+                assert int(log2_bins(jnp.asarray([below]))[0]) == e + 126
+
+    def test_zero_marked_minus_one_and_excluded(self):
+        x = jnp.asarray([0.0, -0.0, 1.0], jnp.float32)
+        assert np.asarray(log2_bins(x)).tolist() == [-1, -1, 127]
+        assert int(jnp.sum(log2_hist(x))) == 1
+
+    def test_subnormals_promoted_to_min_normal_bin(self):
+        # CPU-only inputs (TPU flushes them); they must not land in bin 0
+        # (whose "edge" would be 2^-127, not representable as normal)
+        x = jnp.asarray([1e-40, MIN_NORMAL / 4], jnp.float32)
+        assert np.asarray(log2_bins(x)).tolist() == [1, 1]
+
+    def test_negatives_binned_by_magnitude(self):
+        x = jnp.asarray([-4.0, 4.0], jnp.float32)
+        assert int(log2_bins(x)[0]) == int(log2_bins(x)[1])
+
+
+class TestThreshold:
+    def _check_bracket(self, x, k):
+        t = float(k2threshold_hist(jnp.asarray(x), k))
+        kth = np.sort(np.abs(x))[::-1][k - 1]
+        assert np.sum(np.abs(x) >= t) >= k
+        assert kth / 2 < t <= kth, (t, kth)
+        return t
+
+    def test_bracket_floor_semantics(self):
+        rng = np.random.default_rng(0)
+        x = rng.standard_normal(4096).astype(np.float32)
+        for k in (1, 7, 100, 2000):
+            self._check_bracket(x, k)
+
+    def test_wide_dynamic_range(self):
+        # magnitudes spanning ~150 octaves with NO data-dependent anchor:
+        # a tiny k must still resolve the huge head, and a large k the
+        # deep tail — the property the bisection buys with its max|x|
+        # anchor pass and the histogram must deliver anchor-free
+        rng = np.random.default_rng(1)
+        mant = rng.standard_normal(8192).astype(np.float32)
+        expo = rng.integers(-120, 30, 8192)
+        x = (mant * np.exp2(expo.astype(np.float32))).astype(np.float32)
+        x = x[np.abs(x) >= MIN_NORMAL]       # keep the input normal-range
+        for k in (1, 3, 50, 1000, len(x) - 5):
+            self._check_bracket(x, k)
+
+    def test_absorbing_zero_only_for_all_zero(self):
+        rng = np.random.default_rng(2)
+        x = rng.standard_normal(1024).astype(np.float32)
+        # scaling far down must never collapse the threshold to 0
+        for scale in (1.0, 1e-10, 1e-30):
+            t = float(k2threshold_hist(jnp.asarray(np.abs(x) * scale), 64))
+            assert t > 0.0
+        assert float(k2threshold_hist(jnp.zeros(256, jnp.float32), 5)) == 0.0
+
+    def test_threshold_always_normal_power_of_two(self):
+        rng = np.random.default_rng(3)
+        x = np.abs(rng.standard_normal(512)).astype(np.float32)
+        t = np.float32(self._check_bracket(x, 10))
+        m = t.view(np.int32) & 0x007FFFFF
+        assert m == 0 and t >= MIN_NORMAL    # exact power of two, normal
+
+    def test_fewer_live_than_k_selects_only_live(self):
+        # degenerate floor: with 3 live elements and k=100 the threshold
+        # falls to the min-normal edge — selecting exactly the live
+        # elements, never "everything" (zeros stay excluded)
+        x = np.zeros(1024, np.float32)
+        x[[3, 500, 900]] = [0.25, 1.0, 7.0]
+        t = float(k2threshold_hist(jnp.asarray(x), 100))
+        assert t == float(MIN_NORMAL)
+        assert int(np.sum(np.abs(x) >= t)) == 3
+
+    def test_traced_k(self):
+        x = jnp.abs(jnp.asarray(np.random.default_rng(4)
+                                .standard_normal(512), jnp.float32))
+        f = jax.jit(k2threshold_hist)
+        t1 = float(f(x, jnp.asarray(16, jnp.int32)))
+        t2 = float(k2threshold_hist(x, 16))
+        assert t1 == t2 > 0
+
+    def test_inf_bin_never_becomes_the_edge(self):
+        # bin-255 occupants (inf/nan — the anomaly guard's territory)
+        # count toward every suffix like the very large elements they
+        # claim to be, but the returned edge itself clamps to bin 254:
+        # its lower edge 2^128 is not a finite f32
+        h = jnp.zeros(HIST_BINS, jnp.int32).at[255].set(50)
+        h = h.at[130].set(50)
+        # k within the inf population: floor rides up to the max edge
+        assert float(hist_to_threshold(h, 10)) == float(np.exp2(127))
+        # k beyond it: the floor drops to the finite bin that covers k
+        assert float(hist_to_threshold(h, 60)) == float(np.exp2(130 - 127))
+
+
+class TestDispatch:
+    def test_k2threshold_method_hist(self):
+        from oktopk_tpu.ops.topk import k2threshold_method
+
+        x = jnp.abs(jnp.asarray(np.random.default_rng(5)
+                                .standard_normal(2048), jnp.float32))
+        got = float(k2threshold_method(x, 32, "hist"))
+        want = float(k2threshold_hist(x, 32))
+        assert got == want > 0
+
+    def test_config_accepts_hist(self):
+        from oktopk_tpu.config import OkTopkConfig
+
+        cfg = OkTopkConfig(n=1024, num_workers=2, threshold_method="hist",
+                           density_schedule=((0, 0.01),), density=0.02)
+        assert cfg.threshold_method == "hist"
+        with pytest.raises(ValueError):
+            OkTopkConfig(n=1024, num_workers=2, threshold_method="nope")

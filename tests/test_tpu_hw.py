@@ -171,3 +171,84 @@ def test_mesh_supports_pallas_on_hw(tpu_dev):
     from oktopk_tpu.comm.mesh import get_mesh
     mesh = get_mesh((1,), ("data",), devices=[tpu_dev])
     assert mesh_supports_pallas(mesh)
+
+
+def test_fused_select_parity_on_chip(tpu_dev):
+    """Mirror of tests/test_fused_select.py fast-branch parity on silicon:
+    the fused residual+select+stage kernel (ops/fused_select.py) compiled
+    through Mosaic must reproduce the portable separate-pass outputs —
+    acc, staged regions, realised count, unclamped probe count, and the
+    MXU one-hot histogram — bit-for-bit."""
+    from oktopk_tpu.ops.fused_select import (fused_select_pallas,
+                                             fused_select_reference)
+
+    rng = np.random.RandomState(21)
+    n = 1 << 18
+    g = rng.randn(n).astype(np.float32)
+    r = (0.1 * rng.randn(n)).astype(np.float32)
+    bounds = np.array([0, n // 3, n], np.int32)
+    with jax.default_device(tpu_dev):
+        got = fused_select_pallas(jnp.asarray(g), jnp.asarray(r), 2.0, 2.5,
+                                  jnp.asarray(bounds), 2, 4096,
+                                  interpret=False)
+        got = [np.asarray(a) for a in got]
+    want = [np.asarray(a) for a in
+            fused_select_reference(jnp.asarray(g), jnp.asarray(r), 2.0, 2.5,
+                                   jnp.asarray(bounds), 2, 4096)]
+    for nm, a, b in zip(("acc", "values", "indices", "counts",
+                         "local_count", "probe_count", "hist"), got, want):
+        np.testing.assert_array_equal(a, b, err_msg=nm)
+
+
+def test_fused_hist_bins_bitcast_on_chip(tpu_dev):
+    """The histogram bins come from f32 exponent-bit extraction
+    (hist_threshold.log2_bins); the fused kernel reproduces them via MXU
+    one-hot accumulation. Octave-boundary magnitudes (exact powers of two,
+    where a float log2 rounds wrong) must land in the right bin under
+    Mosaic's bitcast lowering, matching the host-side scatter-add."""
+    from oktopk_tpu.ops.fused_select import fused_select_pallas
+    from oktopk_tpu.ops.hist_threshold import log2_hist
+
+    rng = np.random.RandomState(22)
+    n = 1 << 15
+    g = (rng.randn(n) * 10.0 ** rng.randint(-30, 20, n)).astype(np.float32)
+    g[::7] = np.exp2(rng.randint(-40, 20, len(g[::7]))).astype(np.float32)
+    r = np.zeros(n, np.float32)
+    bounds = np.array([0, n], np.int32)
+    with jax.default_device(tpu_dev):
+        hist = np.asarray(fused_select_pallas(
+            jnp.asarray(g), jnp.asarray(r), 1.0, 1.25, jnp.asarray(bounds),
+            1, 4096, interpret=False)[6])
+    np.testing.assert_array_equal(hist, np.asarray(log2_hist(jnp.asarray(g))))
+
+
+def test_fused_repair_branch_parity_on_chip(tpu_dev):
+    """Mirror of tests/test_fused_select.py::test_repair_branch on silicon:
+    scattered dense blocks overflow CAPB_FAST so the shared _pack_finalize
+    repair kernel re-stages them from the FUSED kernel's own acc output —
+    the handoff between the fused staging layout and the repair path under
+    Mosaic."""
+    from oktopk_tpu.ops.compaction import BLK, CAPB_FAST, _novf_cap
+    from oktopk_tpu.ops.fused_select import (fused_select_pallas,
+                                             fused_select_reference)
+
+    rng = np.random.RandomState(23)
+    n = 64 * BLK
+    g = rng.randn(n).astype(np.float32) * 0.1
+    for b in (3, 17, 40):
+        g[b * BLK:(b + 1) * BLK] = rng.randn(BLK) * 10 + 20
+    r = (0.01 * rng.randn(n)).astype(np.float32)
+    raw = (np.abs(g + r).reshape(-1, BLK) >= 1.0).sum(axis=1)
+    assert 0 < int((raw > CAPB_FAST).sum()) <= _novf_cap(64)
+    bounds = np.array([0, n // 2, n], np.int32)
+    with jax.default_device(tpu_dev):
+        got = fused_select_pallas(jnp.asarray(g), jnp.asarray(r), 1.0, 1.25,
+                                  jnp.asarray(bounds), 2, 8 * BLK,
+                                  interpret=False)
+        got = [np.asarray(a) for a in got]
+    want = [np.asarray(a) for a in
+            fused_select_reference(jnp.asarray(g), jnp.asarray(r), 1.0, 1.25,
+                                   jnp.asarray(bounds), 2, 8 * BLK)]
+    for nm, a, b in zip(("acc", "values", "indices", "counts",
+                         "local_count", "probe_count", "hist"), got, want):
+        np.testing.assert_array_equal(a, b, err_msg=nm)
