@@ -332,6 +332,8 @@ def step_time_probe(iters=10):
         out["grad_nonfinite"] = int(_np.asarray(m["grad_nonfinite"]))
         out["steps_skipped"] = int(_np.asarray(m["steps_skipped"]))
         out["fallback_events"] = trainer.supervisor.fallback_events
+        out["remesh_events"] = trainer.supervisor.remesh_events
+        out["retune_events"] = trainer.retune_events
         print("STEP_PROBE " + json.dumps(out), flush=True)
     except Exception as e:
         print(f"[bench] resilience probe failed: {e!r}", file=sys.stderr)
@@ -406,7 +408,8 @@ def main():
                     "peak_flops_bf16_assumed",
                     "mfu_dense", "mfu_oktopk", "mfu_dense_bs256",
                     "mfu_oktopk_bs256", "mfu_dense_bf16_bs256",
-                    "grad_nonfinite", "steps_skipped", "fallback_events"):
+                    "grad_nonfinite", "steps_skipped", "fallback_events",
+                    "remesh_events", "retune_events"):
             if key in steps:
                 rec[key] = (round(steps[key], 3)
                             if isinstance(steps[key], float)

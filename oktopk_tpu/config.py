@@ -390,6 +390,32 @@ class TrainConfig:
     # JSONL health-journal path; None keeps the journal in memory.
     resilience_journal: Optional[str] = None
 
+    # ---- closed-loop policies (resilience/feedback.py, density.py) ----
+    # Fault→autotune feedback: when True (and obs is on) the trainer
+    # watches the bus for sustained regression/guard_trip streams and
+    # forces an autotune re-calibrate + re-tune when the vote passes —
+    # a degraded fabric re-tunes the plan instead of degrading forever.
+    resilience_feedback: bool = False
+    # Sliding evidence window (steps) and the votes needed inside it.
+    resilience_feedback_window: int = 32
+    resilience_feedback_signals: int = 3
+    # Steps to back off after a forced re-tune (re-tuning recompiles).
+    resilience_feedback_cooldown: int = 64
+    # Guard-aware density backoff: when True (with resilience) the
+    # effective selection density hysteretically backs off after
+    # repeated near-abs_limit / guard-skip steps and re-advances after
+    # a clean streak (resilience/density.py).
+    resilience_density_backoff: bool = False
+    # "Near" band: reduced_absmax > near_ratio * abs_limit is pressure.
+    resilience_near_ratio: float = 0.1
+    # Consecutive pressured steps before backing off one level.
+    resilience_backoff_steps: int = 3
+    # Density multiplier per level, and the level bound.
+    resilience_backoff_factor: float = 0.5
+    resilience_backoff_max_level: int = 3
+    # Consecutive clean steps before re-advancing one level.
+    resilience_clean_streak: int = 8
+
     # ---- unified observability (obs/) ---------------------------------
     # When True the trainer runs an event bus + run journal: per-step
     # metrics, autotune decisions, guard trips, fallbacks, checkpoints,

@@ -54,7 +54,8 @@ EVENT_SCHEMAS: Dict[str, Dict[str, Dict[str, tuple]]] = {
                      "wire_bytes": _NUM, "local_k": _NUM,
                      "global_k": _NUM, "eps_vs_dense": _NUM,
                      "step_skipped": _NUM, "steps_skipped": _NUM,
-                     "bucket_anomalies": _NUM, "dt_ms": _NUM},
+                     "bucket_anomalies": _NUM, "dt_ms": _NUM,
+                     "reduced_absmax": _NUM},
     },
     # autotuner fabric calibration (autotune/policy.py)
     "calibration": {
@@ -87,7 +88,8 @@ EVENT_SCHEMAS: Dict[str, Dict[str, Dict[str, tuple]]] = {
     },
     "fault_seen": {
         "required": {"step": _NUM, "kind": _STR},
-        "optional": {"buckets": _LIST, "counts": _OPT_LIST},
+        "optional": {"buckets": _LIST, "counts": _OPT_LIST,
+                     "workers": _OPT_LIST},
     },
     "fallback": {
         "required": {"step": _NUM, "bucket": _NUM, "algo": _STR,
@@ -102,6 +104,30 @@ EVENT_SCHEMAS: Dict[str, Dict[str, Dict[str, tuple]]] = {
     "restore_unavailable": {
         "required": {"step": _NUM, "last_good_step": _NUM},
         "optional": {},
+    },
+    # elastic resize (train/trainer.py resize_workers): which state
+    # carried across the world-size change vs was re-initialised, and
+    # what triggered it ("chip_loss" via the supervisor remesh action,
+    # "manual" for operator-driven resizes)
+    "remesh": {
+        "required": {"step": _NUM, "old_world": _NUM, "new_world": _NUM,
+                     "trigger": _STR},
+        "optional": {"dead_workers": _LIST, "carried": _LIST,
+                     "reinitialised": _LIST},
+    },
+    # forced autotune re-calibration (resilience/feedback.py via
+    # Trainer.force_retune); "signals" are the evidence steps — the
+    # regression/guard_trip events that voted. Followed in the journal
+    # by the calibration + autotune_decision events it caused.
+    "retune": {
+        "required": {"step": _NUM, "trigger": _STR},
+        "optional": {"signals": _LIST, "cleared": _STR},
+    },
+    # guard-aware density backoff level change (resilience/density.py)
+    "density_backoff": {
+        "required": {"step": _NUM, "direction": _STR, "level": _NUM,
+                     "scale": _NUM},
+        "optional": {"trigger": _STR},
     },
     # checkpoint written (resilience/supervisor.py note_checkpoint;
     # qualified=False means skips were in flight so it is NOT a

@@ -269,6 +269,7 @@ def build_sparse_grad_step(
                    if momentum_correction else None)
         results = [None] * len(leaves)
         sp_olds, sp_news, new_moms, bad_counts = [], [], [], []
+        absmaxes = []
         vol = lk = gk = wbytes = jnp.asarray(0.0, jnp.float32)
         eps_num = eps_den = jnp.asarray(0.0, jnp.float32)
         for bi, idxs in enumerate(buckets):
@@ -302,6 +303,10 @@ def build_sparse_grad_step(
             if guard is not None:
                 bad_counts.append(
                     _guard_mod.local_anomaly_count(flat, reduced, guard))
+                # peak reduced magnitude: the guard-pressure signal the
+                # density-backoff policy watches (how close delivered
+                # gradients crowd cfg.abs_limit without tripping it)
+                absmaxes.append(jnp.max(jnp.abs(reduced)))
             if len(idxs) == 1:
                 results[idxs[0]] = reduced.reshape(leaves[idxs[0]].shape)
             else:
@@ -384,6 +389,10 @@ def build_sparse_grad_step(
             metrics["step_skipped"] = any_bad.astype(jnp.int32)
             metrics["steps_skipped"] = health.steps_skipped
             metrics["bucket_anomalies"] = (flags > 0).astype(jnp.int32)
+            # replicated (reduced is post-collective, identical on every
+            # worker); NaN when the step carried nonfinites — consumers
+            # treat the skip flag as authoritative there
+            metrics["reduced_absmax"] = jnp.max(jnp.stack(absmaxes))
         elif has_health:
             # fault plan without a guard: the attempt counter still has
             # to advance or a one-step fault would re-inject forever

@@ -26,16 +26,36 @@ diverge from dense. This package closes the loop in three layers:
 4. `journal`    — JSONL health log (same shape as ``autotune/journal.py``):
    every fault seen, guard trip, fallback and restore, with step index
    and bucket id.
+
+On top of the detectors sit the closed-loop policies (this PR's
+"self-healing control plane", docs/RESILIENCE.md "Closed-loop
+policies"):
+
+5. `faults.dead_workers` + ``Supervisor.note_chip_loss`` — chip loss
+   escalates straight to a ``remesh`` action; the trainer resizes onto
+   the surviving devices without a requeue.
+6. `feedback`   — :class:`AutotuneFeedback` watches the obs bus for
+   sustained ``regression``/``guard_trip`` streams and forces an
+   autotune re-calibrate + re-tune.
+7. `density`    — :class:`DensityBackoff` hysteretically backs the
+   effective selection density off under repeated near-``abs_limit``
+   guard pressure, re-advancing after a clean streak.
+8. `drills`     — the deterministic chaos-drill catalog behind
+   ``scripts/chaos_drill.py`` and the ``chaos``-marked tests: scripted
+   incidents asserting both recovery and the journalled timeline.
 """
 
+from oktopk_tpu.resilience.density import DensityBackoff  # noqa: F401
 from oktopk_tpu.resilience.faults import (  # noqa: F401
     FaultPlan,
     FaultSpec,
+    dead_workers,
     inject_grad_faults,
     latency_ms,
     make_wire_hook,
     with_latency,
 )
+from oktopk_tpu.resilience.feedback import AutotuneFeedback  # noqa: F401
 from oktopk_tpu.resilience.guard import (  # noqa: F401
     GuardConfig,
     HealthState,

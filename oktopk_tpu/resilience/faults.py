@@ -9,7 +9,7 @@ emulated-mesh chaos tests deterministic (and what distinguishes a fault
 *drill* from real corruption — the guard/supervisor must not be able to
 tell the difference).
 
-Three fault families, mirroring what degrades in real sparse pipelines:
+Five fault families, mirroring what degrades in real sparse pipelines:
 
 - ``nan_grad`` / ``inf_grad``: the local gradient blows up on one (or
   every) worker — the failure the reference merely warns about
@@ -29,6 +29,18 @@ Three fault families, mirroring what degrades in real sparse pipelines:
   mesh (:func:`latency_ms` / :func:`with_latency`) — degraded-fabric
   behaviour for the supervisor/autotuner timing paths, host-side so CPU
   tests can exercise it without a slow wire.
+- ``scale_grad``: the local gradient is *scaled* (``scale``) rather than
+  replaced — the near-``abs_limit`` regime where everything is still
+  finite but the reduced magnitudes crowd the guard's absurdity limit.
+  Unlike nan/inf, the per-element structure survives, so top-k selection
+  stays deterministic; this is the drill fuel for the guard-aware
+  density backoff policy (``resilience/density.py``).
+- ``chip_loss``: rank ``worker`` (required ≥ 0) dies permanently at
+  ``step`` — the orchestrator-visible hardware failure, not a data
+  fault. Host-side only (:func:`dead_workers`); the supervisor
+  escalates it to a ``remesh`` action that drives
+  ``Trainer.resize_workers`` onto the surviving devices. ``duration``
+  is ignored: chips do not come back mid-run.
 """
 
 from __future__ import annotations
@@ -40,8 +52,8 @@ from typing import Callable, Optional, Tuple
 import jax.numpy as jnp
 from jax import lax
 
-FAULT_KINDS = ("nan_grad", "inf_grad", "wire_bitflip", "wire_zero",
-               "latency")
+FAULT_KINDS = ("nan_grad", "inf_grad", "scale_grad", "wire_bitflip",
+               "wire_zero", "latency", "chip_loss")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -53,7 +65,9 @@ class FaultSpec:
     all). ``count`` bounds the corruption to the leading elements of the
     target buffer (-1 = the whole buffer). ``latency_ms`` applies to
     ``kind == "latency"`` only; ``bit_mask`` overrides the XOR pattern of
-    ``wire_bitflip`` (0 = flip the top exponent bit of the wire dtype).
+    ``wire_bitflip`` (0 = flip the top exponent bit of the wire dtype);
+    ``scale`` is the multiplier of ``scale_grad``. ``chip_loss`` is
+    permanent (``duration`` ignored) and must name a concrete ``worker``.
     """
 
     kind: str
@@ -64,6 +78,7 @@ class FaultSpec:
     count: int = -1
     latency_ms: float = 0.0
     bit_mask: int = 0
+    scale: float = 1.0
 
     def __post_init__(self):
         if self.kind not in FAULT_KINDS:
@@ -73,6 +88,8 @@ class FaultSpec:
             raise ValueError(f"duration must be >= 1, got {self.duration}")
         if self.step < 0:
             raise ValueError(f"step must be >= 0, got {self.step}")
+        if self.kind == "chip_loss" and self.worker < 0:
+            raise ValueError("chip_loss must name a concrete worker (>= 0)")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -90,7 +107,11 @@ class FaultPlan:
 
     @property
     def grad_faults(self) -> Tuple[FaultSpec, ...]:
-        return self.of_kind("nan_grad", "inf_grad")
+        return self.of_kind("nan_grad", "inf_grad", "scale_grad")
+
+    @property
+    def chip_faults(self) -> Tuple[FaultSpec, ...]:
+        return self.of_kind("chip_loss")
 
     @property
     def wire_faults(self) -> Tuple[FaultSpec, ...]:
@@ -127,11 +148,29 @@ def inject_grad_faults(plan: FaultPlan, flat: jnp.ndarray, step, rank,
     for f in plan.grad_faults:
         if f.bucket >= 0 and f.bucket != bucket:
             continue
-        bad = jnp.inf if f.kind == "inf_grad" else jnp.nan
+        if f.kind == "scale_grad":
+            # multiplicative blow-up: finite, structure-preserving — the
+            # near-abs_limit regime the density backoff drills target
+            corrupted = flat * jnp.asarray(f.scale, flat.dtype)
+        else:
+            bad = jnp.inf if f.kind == "inf_grad" else jnp.nan
+            corrupted = jnp.broadcast_to(
+                jnp.asarray(bad, flat.dtype), flat.shape)
         where = _leading_mask(flat.size, f.count)
-        poisoned = jnp.where(where, jnp.asarray(bad, flat.dtype), flat)
+        poisoned = jnp.where(where, corrupted, flat)
         flat = jnp.where(_active(f, step, rank), poisoned, flat)
     return flat
+
+
+def dead_workers(plan: FaultPlan, step: int) -> Tuple[int, ...]:
+    """Ranks whose chip has died at or before host step ``step``.
+
+    Chip loss is permanent — ``duration`` is ignored — so this is the
+    cumulative set, sorted. Host-side by design: a dead chip is an
+    orchestrator-level observation, never a traced value.
+    """
+    return tuple(sorted({f.worker for f in plan.chip_faults
+                         if f.step <= step}))
 
 
 def _bitflip(x: jnp.ndarray, mask: int) -> jnp.ndarray:
@@ -190,13 +229,20 @@ def latency_ms(plan: FaultPlan, step: int, bucket: int = 0) -> float:
 
 
 def with_latency(step_fn, plan: FaultPlan, bucket: int = 0,
-                 sleep=time.sleep):
+                 sleep=time.sleep, start_step: int = 0):
     """Wrap a built allreduce/train step with the plan's latency
     inflation: each call sleeps ``latency_ms`` for its (host-side) step
     index before dispatching. This is the emulated-mesh seam for
     exercising timing-sensitive policies (autotune trials, supervisor
-    backoff) under a degraded fabric without a slow wire."""
-    counter = {"step": 0}
+    backoff) under a degraded fabric without a slow wire.
+
+    ``start_step`` seeds the internal counter so the plan's step indices
+    line up with the run's attempted-step clock after a checkpoint
+    restore or an elastic re-mesh — without it a resumed run would replay
+    the plan from step 0 and faults would land on the wrong steps. The
+    wrapped fn exposes ``wrapped.seek(step)`` to re-seed in place (e.g.
+    after a mid-run restore)."""
+    counter = {"step": int(start_step)}
 
     def wrapped(*args, **kwargs):
         ms = latency_ms(plan, counter["step"], bucket)
@@ -205,6 +251,10 @@ def with_latency(step_fn, plan: FaultPlan, bucket: int = 0,
             sleep(ms / 1e3)
         return step_fn(*args, **kwargs)
 
+    def seek(step: int) -> None:
+        counter["step"] = int(step)
+
+    wrapped.seek = seek
     return wrapped
 
 

@@ -1,0 +1,81 @@
+"""Fault→autotune feedback: sustained degradation forces a re-tune.
+
+PR 1's autotuner calibrates the fabric once and re-tunes on a *step
+cadence*; PR 2's detectors see what actually changed. This policy closes
+the gap "On the Utility of Gradient Compression" (arXiv 2103.00543)
+warns about — a statically tuned plan stops paying the moment conditions
+drift. It subscribes to the unified obs bus and, when a sustained stream
+of ``regression`` events (or guard strikes) lands inside a short window,
+tells the trainer to drop its :class:`~oktopk_tpu.autotune.Autotuner`
+entirely. A fresh tuner has ``coeffs=None``, so the next ``tune()``
+re-probes the (now degraded) fabric before re-deciding — exactly the
+path ``Trainer.resize_workers`` already takes after an elastic resize.
+
+The causal chain lands in the journal as linked events:
+``fault_seen`` → ``regression``/``guard_trip`` (the evidence) →
+``retune`` (this policy firing, carrying the evidence steps) →
+``calibration`` (the forced re-probe) → ``autotune_decision`` (the new
+plan). ``scripts/obs_report.py`` renders the chain in the incident
+timeline.
+
+Host-side and event-driven: nothing here is traced, and a run without
+faults never pays more than a list append per flagged event.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+
+class AutotuneFeedback:
+    """Sliding-window vote over degradation events on the obs bus.
+
+    Fires (returns a trigger descriptor from :meth:`should_retune`) when
+    at least ``min_signals`` matching events landed within the last
+    ``window_steps`` steps, then backs off for ``cooldown_steps`` so one
+    incident cannot thrash the tuner with recompiles — re-tuning is
+    expensive (calibration probes + candidate trials), so the evidence
+    bar is deliberately higher than the guard's single-step trip.
+    """
+
+    def __init__(self, bus=None, window_steps: int = 32,
+                 min_signals: int = 3, cooldown_steps: int = 64,
+                 kinds: Sequence[str] = ("regression", "guard_trip")):
+        self.window_steps = max(1, int(window_steps))
+        self.min_signals = max(1, int(min_signals))
+        self.cooldown_steps = max(0, int(cooldown_steps))
+        self.kinds = tuple(kinds)
+        self.signals: List[Tuple[int, str]] = []   # (step, event kind)
+        self.fired = 0
+        self._cooldown_until = -1
+        if bus is not None:
+            bus.subscribe(self._on_event)
+
+    # Bus subscriber — must never raise (the bus swallows subscriber
+    # failures into its dropped counter, but a silent drop here would
+    # lose evidence without a trace).
+    def _on_event(self, entry: Dict[str, Any]) -> None:
+        if entry.get("event") not in self.kinds:
+            return
+        step = entry.get("step")
+        if isinstance(step, (int, float)):
+            self.signals.append((int(step), str(entry["event"])))
+
+    def should_retune(self, step: int) -> Optional[Dict[str, Any]]:
+        """Poll at host step ``step``; consume the evidence and return a
+        ``{"trigger": kind, "signals": [steps...]}`` descriptor when the
+        window vote passes, else None."""
+        step = int(step)
+        # stale evidence ages out regardless of cooldown
+        self.signals = [(s, k) for s, k in self.signals
+                        if step - s < self.window_steps]
+        if step < self._cooldown_until:
+            return None
+        if len(self.signals) < self.min_signals:
+            return None
+        recent = list(self.signals)
+        self.signals = []
+        self.fired += 1
+        self._cooldown_until = step + self.cooldown_steps
+        return {"trigger": recent[-1][1],
+                "signals": [s for s, _ in recent]}

@@ -21,6 +21,13 @@ containers/relays. Events (all carry ``event`` and ``step``):
    "last_good_step": 24}
 
   {"event": "restore_unavailable", "step": 30, "last_good_step": -1}
+
+  {"event": "remesh", "step": 40, "old_world": 8, "new_world": 7,
+   "trigger": "chip_loss", "dead_workers": [5],
+   "carried": ["params", ...], "reinitialised": ["sparse_state", ...]}
+
+  {"event": "density_backoff", "step": 52, "direction": "backoff",
+   "level": 1, "scale": 0.5, "trigger": "guard_skip"}
 """
 
 from __future__ import annotations
@@ -42,11 +49,15 @@ class HealthJournal(DecisionJournal):
 
     def fault_seen(self, step: int, kind: str,
                    buckets: Sequence[int] = (),
-                   counts: Optional[Sequence[int]] = None):
-        return self.record("fault_seen", step=int(step), kind=kind,
-                           buckets=[int(b) for b in buckets],
-                           counts=(None if counts is None
-                                   else [int(c) for c in counts]))
+                   counts: Optional[Sequence[int]] = None,
+                   workers: Optional[Sequence[int]] = None):
+        fields = dict(step=int(step), kind=kind,
+                      buckets=[int(b) for b in buckets],
+                      counts=(None if counts is None
+                              else [int(c) for c in counts]))
+        if workers is not None:
+            fields["workers"] = [int(w) for w in workers]
+        return self.record("fault_seen", **fields)
 
     def fallback(self, step: int, bucket: int, algo: str, strikes: int):
         return self.record("fallback", step=int(step), bucket=int(bucket),
@@ -59,3 +70,20 @@ class HealthJournal(DecisionJournal):
                                last_good_step=int(last_good_step))
         return self.record("restore", step=int(step), ckpt=ckpt,
                            last_good_step=int(last_good_step))
+
+    def remesh(self, step: int, old_world: int, new_world: int,
+               trigger: str, dead_workers: Sequence[int] = (),
+               carried: Sequence[str] = (),
+               reinitialised: Sequence[str] = ()):
+        return self.record("remesh", step=int(step),
+                           old_world=int(old_world),
+                           new_world=int(new_world), trigger=str(trigger),
+                           dead_workers=[int(w) for w in dead_workers],
+                           carried=list(carried),
+                           reinitialised=list(reinitialised))
+
+    def density_backoff(self, step: int, direction: str, level: int,
+                        scale: float, trigger: str = ""):
+        return self.record("density_backoff", step=int(step),
+                           direction=str(direction), level=int(level),
+                           scale=float(scale), trigger=str(trigger))

@@ -22,10 +22,17 @@ escalation ladder, mirroring SparCML's sparse/dense switching
    not making progress (e.g. params already poisoned before the guard
    was enabled, or every bucket degraded): restore from the last good
    checkpoint registered via :meth:`note_checkpoint`.
+5. **remesh** — a chip loss (:meth:`note_chip_loss`, fed by the host
+   orchestrator seam ``faults.dead_workers``) is not evidence to weigh:
+   the rank is gone. It bypasses strikes *and* the cooldown and emits a
+   ``remesh`` action immediately; the trainer executes it via
+   ``Trainer.resize_workers`` onto the surviving devices, carrying
+   params/opt state and this supervisor's counters across the resize so
+   training resumes without a requeue.
 
-After any escalation the supervisor backs off for ``cooldown_steps``
-before escalating again, so one burst of faults cannot cascade a
-fallback AND a restore from the same evidence.
+After any evidence-based escalation the supervisor backs off for
+``cooldown_steps`` before escalating again, so one burst of faults
+cannot cascade a fallback AND a restore from the same evidence.
 
 All state is plain Python ints/lists (:meth:`to_state` /
 :meth:`load_state`) so it checkpoints alongside the train state and a
@@ -46,9 +53,10 @@ from oktopk_tpu.resilience.journal import HealthJournal
 class Action:
     """One escalation decision for the trainer to execute."""
 
-    kind: str                    # "fallback" | "restore"
-    bucket: int = -1             # fallback target (-1 for restore)
+    kind: str                    # "fallback" | "restore" | "remesh"
+    bucket: int = -1             # fallback target (-1 otherwise)
     ckpt: Optional[str] = None   # restore source (None = unavailable)
+    workers: tuple = ()          # remesh: ranks to drop from the mesh
 
 
 class Supervisor:
@@ -69,6 +77,8 @@ class Supervisor:
         self.last_good_ckpt: Optional[str] = None
         self.fallback_events = 0
         self.restore_events = 0
+        self.remesh_events = 0
+        self.dead_workers: List[int] = []
         self._cooldown_until = -1
 
     # ---- inputs -------------------------------------------------------
@@ -85,6 +95,22 @@ class Supervisor:
             self.last_good_step = int(step)
         self.journal.record("checkpoint", step=int(step), path=path,
                             qualified=qualified)
+
+    def note_chip_loss(self, step: int, workers: Sequence[int]
+                       ) -> List[Action]:
+        """Record permanently dead ranks; emit a ``remesh`` action for any
+        newly observed ones. Idempotent per worker — the trainer can call
+        this every supervision cadence with the cumulative dead set. A
+        dead chip is a fact, not evidence: no strikes, no cooldown."""
+        step = int(step)
+        newly = [int(w) for w in workers
+                 if int(w) not in self.dead_workers]
+        if not newly:
+            return []
+        self.dead_workers.extend(newly)
+        self.remesh_events += 1
+        self.journal.fault_seen(step, "chip_loss", workers=newly)
+        return [Action("remesh", workers=tuple(newly))]
 
     def observe(self, step: int, metrics: Dict[str, Any]) -> List[Action]:
         """Digest one step's guard metrics; return escalation actions.
@@ -145,6 +171,9 @@ class Supervisor:
             "last_good_ckpt": self.last_good_ckpt or "",
             "fallback_events": int(self.fallback_events),
             "restore_events": int(self.restore_events),
+            "remesh_events": int(self.remesh_events),
+            "dead_workers": [int(w) for w in self.dead_workers],
+            "cooldown_until": int(self._cooldown_until),
         }
 
     def load_state(self, state: Dict[str, Any]) -> "Supervisor":
@@ -168,6 +197,10 @@ class Supervisor:
         self.last_good_ckpt = str(ck) or None
         self.fallback_events = int(state.get("fallback_events", 0))
         self.restore_events = int(state.get("restore_events", 0))
+        self.remesh_events = int(state.get("remesh_events", 0))
+        self.dead_workers = [int(w) for w in np.asarray(
+            state.get("dead_workers", [])).reshape(-1).tolist()]
+        self._cooldown_until = int(state.get("cooldown_until", -1))
         return self
 
 

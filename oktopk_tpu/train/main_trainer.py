@@ -74,6 +74,33 @@ def parse_args(argv=None):
                         "even while finite (wire bit-flips land ~1e38)")
     p.add_argument("--resilience-journal", default=None,
                    help="JSONL health-journal path (docs/RESILIENCE.md)")
+    p.add_argument("--resilience-feedback", action="store_true",
+                   help="fault->autotune feedback: a sustained stream of "
+                        "regression/guard_trip events forces an autotune "
+                        "re-calibrate + re-tune against the degraded "
+                        "fabric (resilience/feedback.py; needs --obs)")
+    p.add_argument("--resilience-feedback-window", type=int, default=32,
+                   help="steps a feedback signal stays live in the vote")
+    p.add_argument("--resilience-feedback-signals", type=int, default=3,
+                   help="signals within the window needed to force a "
+                        "re-tune")
+    p.add_argument("--resilience-feedback-cooldown", type=int, default=64,
+                   help="steps between forced re-tunes")
+    p.add_argument("--resilience-density-backoff", action="store_true",
+                   help="guard-aware density backoff: repeated "
+                        "near-abs-limit/guard-skip steps back the "
+                        "effective density off (bounded, hysteretic, "
+                        "journalled; resilience/density.py)")
+    p.add_argument("--resilience-near-ratio", type=float, default=0.1,
+                   help="fraction of abs-limit counted as guard pressure")
+    p.add_argument("--resilience-backoff-steps", type=int, default=3,
+                   help="pressured steps before one backoff level")
+    p.add_argument("--resilience-backoff-factor", type=float, default=0.5,
+                   help="density multiplier per backoff level")
+    p.add_argument("--resilience-backoff-max-level", type=int, default=3,
+                   help="deepest backoff level")
+    p.add_argument("--resilience-clean-streak", type=int, default=8,
+                   help="clean steps before re-advancing one level")
     p.add_argument("--obs", action="store_true",
                    help="unified run journal (obs/): per-step metrics, "
                         "autotune decisions, guard trips, checkpoints, "
@@ -166,6 +193,16 @@ def main(argv=None):
         resilience_strikes=args.resilience_strikes,
         resilience_abs_limit=args.resilience_abs_limit,
         resilience_journal=args.resilience_journal,
+        resilience_feedback=args.resilience_feedback,
+        resilience_feedback_window=args.resilience_feedback_window,
+        resilience_feedback_signals=args.resilience_feedback_signals,
+        resilience_feedback_cooldown=args.resilience_feedback_cooldown,
+        resilience_density_backoff=args.resilience_density_backoff,
+        resilience_near_ratio=args.resilience_near_ratio,
+        resilience_backoff_steps=args.resilience_backoff_steps,
+        resilience_backoff_factor=args.resilience_backoff_factor,
+        resilience_backoff_max_level=args.resilience_backoff_max_level,
+        resilience_clean_streak=args.resilience_clean_streak,
         obs=args.obs,
         obs_trace_on_anomaly=args.obs_trace_on_anomaly,
         obs_trace_steps=args.obs_trace_steps,

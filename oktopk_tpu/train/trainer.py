@@ -170,6 +170,28 @@ class Trainer:
                     self.supervisor.journal.fault_seen(
                         f.step, f"planned:{f.kind}", buckets=[f.bucket])
 
+        # ---- closed-loop policies (resilience/feedback.py, density.py)
+        self.feedback = None
+        if cfg.resilience_feedback and self.bus is not None:
+            from oktopk_tpu.resilience import AutotuneFeedback
+            self.feedback = AutotuneFeedback(
+                self.bus, window_steps=cfg.resilience_feedback_window,
+                min_signals=cfg.resilience_feedback_signals,
+                cooldown_steps=cfg.resilience_feedback_cooldown)
+        self.density_backoff = None
+        if cfg.resilience and cfg.resilience_density_backoff:
+            from oktopk_tpu.resilience import DensityBackoff
+            self.density_backoff = DensityBackoff(
+                abs_limit=cfg.resilience_abs_limit,
+                near_ratio=cfg.resilience_near_ratio,
+                backoff_steps=cfg.resilience_backoff_steps,
+                factor=cfg.resilience_backoff_factor,
+                max_level=cfg.resilience_backoff_max_level,
+                clean_streak=cfg.resilience_clean_streak)
+        self._density_scale = 1.0  # density-backoff multiplier (≤ 1)
+        self.retune_events = 0     # forced re-calibrations executed
+        self._fake_ms = None       # remembered trial-timing injector
+
         self.state = init_dist_state(
             params, self.model_state, self.optimizer, self.algo_cfg,
             momentum_correction=bool(self._mc_factor),
@@ -196,6 +218,20 @@ class Trainer:
         if self._plans:
             compressor = [p.algo for p in self._plans]
             densities = [p.density for p in self._plans]
+        acfg = self.algo_cfg
+        if self._density_scale < 1.0:
+            # guard-aware backoff: shrink the *effective* selection
+            # density (schedule included) without touching cfg.density —
+            # capacity sizing stays pinned so wire buffers never re-size
+            # across a backoff level change
+            if acfg.density_schedule:
+                acfg = acfg.replace(density_schedule=tuple(
+                    (s, d * self._density_scale)
+                    for s, d in acfg.density_schedule))
+            else:
+                densities = [d * self._density_scale for d in
+                             (densities if densities is not None
+                              else [self.cfg.density] * nb)]
         if self._forced_dense:
             from oktopk_tpu.resilience.supervisor import plan_with_fallbacks
             names = (list(compressor) if not isinstance(compressor, str)
@@ -205,7 +241,7 @@ class Trainer:
                 densities = [1.0 if b in self._forced_dense else d
                              for b, d in enumerate(densities)]
         return build_sparse_grad_step(
-            self._loss_fn, self.optimizer, self.algo_cfg, self.mesh,
+            self._loss_fn, self.optimizer, acfg, self.mesh,
             compressor=compressor, axis_name=self.axis_name,
             nsteps_update=self.cfg.nsteps_update,
             grad_clip=self.cfg.grad_clip, warmup=self._warmup,
@@ -254,8 +290,13 @@ class Trainer:
         """
         from oktopk_tpu.autotune import Autotuner
 
+        if fake_ms is not None:
+            # remember the injector: a forced re-tune (force_retune) or
+            # elastic resize rebuilds the tuner and must keep measuring
+            # through the same seam
+            self._fake_ms = fake_ms
         if self.autotuner is None:
-            self.autotuner = self._make_autotuner(fake_ms=fake_ms)
+            self.autotuner = self._make_autotuner(fake_ms=self._fake_ms)
         old = self._plans
         self._plans = self.autotuner.tune(step=step, mesh=self.mesh)
         if Autotuner.plans_changed(self._plans, old):
@@ -269,6 +310,39 @@ class Trainer:
         if self.autotuner is None or self.autotuner.should_retune(step):
             self.autotune(step=step)
 
+    def force_retune(self, step: int, trigger: str = "manual",
+                     signals=()):
+        """Drop the autotuner and re-tune from scratch — the
+        fault→autotune feedback path (resilience/feedback.py). A fresh
+        tuner has no fabric coefficients, so the next ``tune()``
+        re-calibrates against the *current* (possibly degraded) fabric
+        before re-deciding; the journal carries the causal chain as
+        ``retune`` (with the evidence steps) → ``calibration`` →
+        ``autotune_decision``. Returns the new plan (None when autotune
+        is off — the retune is still journalled so the evidence isn't
+        lost)."""
+        self.retune_events += 1
+        if self.bus is not None:
+            self.bus.emit("retune", step=int(step), trigger=str(trigger),
+                          signals=[int(s) for s in signals],
+                          cleared="autotuner")
+        self.autotuner = None
+        if self.cfg.autotune:
+            return self.autotune(step=step)
+        return None
+
+    def check_feedback(self, step: int):
+        """Poll the fault→autotune feedback policy; execute the forced
+        re-calibrate + re-tune when its window vote passes. Returns the
+        trigger descriptor (or None)."""
+        if self.feedback is None:
+            return None
+        trig = self.feedback.should_retune(step)
+        if trig is not None:
+            self.force_retune(step, trigger=trig["trigger"],
+                              signals=trig["signals"])
+        return trig
+
     # ---- resilience supervision ---------------------------------------
 
     def supervise(self, step: int, metrics) -> None:
@@ -276,19 +350,60 @@ class Trainer:
         whatever it escalates to: a per-bucket dense fallback rebuilds
         the jitted step exactly like an autotune plan change; a restore
         reloads the last good checkpoint registered via
-        :meth:`note_checkpoint` (journalled either way)."""
+        :meth:`note_checkpoint` (journalled either way); a chip loss
+        remeshes onto the surviving devices; and the density-backoff
+        policy digests the step's guard pressure."""
         if self.supervisor is None:
             return
+        # chip loss is a host/orchestrator observation, not a guard
+        # metric: poll the plan's dead set (faults.dead_workers) and let
+        # the supervisor escalate any newly dead rank straight to remesh
+        if self._fault_plan is not None:
+            from oktopk_tpu.resilience.faults import dead_workers
+            dead = dead_workers(self._fault_plan, step)
+            if dead:
+                for act in self.supervisor.note_chip_loss(step, dead):
+                    self._execute_action(act, step)
         host = {k: np.asarray(metrics[k])
                 for k in ("step_skipped", "bucket_anomalies")
                 if k in metrics}
         for act in self.supervisor.observe(step, host):
-            if act.kind == "fallback":
-                # forced_dense already updated by the supervisor
+            self._execute_action(act, step)
+        if self.density_backoff is not None and "reduced_absmax" in metrics:
+            change = self.density_backoff.observe(
+                step, absmax=float(np.asarray(metrics["reduced_absmax"])),
+                skipped=int(np.asarray(metrics.get("step_skipped", 0))))
+            if change is not None:
+                self._density_scale = float(change["scale"])
+                self.supervisor.journal.density_backoff(step, **change)
                 self.step_fn = self._build_step()
-            elif act.kind == "restore" and act.ckpt:
-                from oktopk_tpu.train.checkpoint import restore_checkpoint
-                self.state, _ = restore_checkpoint(act.ckpt, self.state)
+
+    def _execute_action(self, act, step: int) -> None:
+        """Execute one supervisor escalation action."""
+        if act.kind == "fallback":
+            # forced_dense already updated by the supervisor
+            self.step_fn = self._build_step()
+        elif act.kind == "restore" and act.ckpt:
+            from oktopk_tpu.train.checkpoint import restore_checkpoint
+            self.state, _ = restore_checkpoint(act.ckpt, self.state)
+        elif act.kind == "remesh":
+            self._execute_remesh(step, act.workers)
+
+    def _execute_remesh(self, step: int, workers) -> None:
+        """Shrink the mesh to the devices whose ranks survive and resize
+        onto it — the no-requeue recovery path for chip loss. Rank i is
+        position i in the flattened device list (the data-parallel-only
+        layout every emulated drill uses)."""
+        dead = {int(w) for w in workers}
+        devs = [d for i, d in enumerate(
+                    np.asarray(self.mesh.devices).reshape(-1))
+                if i not in dead]
+        if not devs:
+            raise RuntimeError(
+                f"chip_loss at step {step} left no surviving devices")
+        new_mesh = get_mesh(axis_names=self.mesh.axis_names, devices=devs)
+        self.resize_workers(new_mesh, trigger="chip_loss",
+                            dead_workers=sorted(dead), step=step)
 
     def note_checkpoint(self, path: str, step: int) -> None:
         """Register a saved checkpoint as a restore candidate (and record
@@ -462,6 +577,11 @@ class Trainer:
                 # check cadence; escalation may rebuild step_fn or
                 # restore state before the next iteration
                 self.supervise(step, metrics)
+            if self.feedback is not None:
+                # fault→autotune feedback: a passing window vote forces
+                # a re-calibrate + re-tune (host-side list ops only
+                # until it actually fires)
+                self.check_feedback(step)
             if metric_writer is not None or self.bus is not None:
                 pending.append((step, metrics))
             if "grad_nonfinite" in metrics:
@@ -514,6 +634,8 @@ class Trainer:
         if self._plans:
             names = [p.algo for p in self._plans]
             densities = [p.density for p in self._plans]
+        if self._density_scale < 1.0 and not self.algo_cfg.density_schedule:
+            densities = [d * self._density_scale for d in densities]
         for b in self._forced_dense:
             if 0 <= b < nb:
                 names[b] = "dense"
@@ -546,18 +668,27 @@ class Trainer:
 
     # ---- elasticity ---------------------------------------------------
 
-    def resize_workers(self, new_mesh: Mesh):
+    def resize_workers(self, new_mesh: Mesh, trigger: str = "manual",
+                       dead_workers=(), step: Optional[int] = None):
         """Rebuild the distributed step for a new world size, keeping model
         and optimizer state.
 
         Reference analogue: the elastic hooks ``err_callback`` ->
         ``trainer.update_nworker`` which rebuild samplers/loaders for a new
-        world size (VGG/main_trainer.py:42-44, VGG/dl_trainer.py:472-493 —
-        detection itself is absent there too; on TPU world changes come from
-        the orchestrator re-invoking with a different mesh). Per-worker
-        algorithm state (residuals, boundaries) is re-initialised for the
-        new topology; replicated state carries over.
+        world size (VGG/main_trainer.py:42-44, VGG/dl_trainer.py:472-493).
+        Detection lives in the supervisor's chip-loss path
+        (:meth:`supervise` → ``note_chip_loss`` → ``remesh`` action →
+        here with ``trigger="chip_loss"``); an orchestrator-driven resize
+        calls this directly (``trigger="manual"``). Per-worker algorithm
+        state (residuals, boundaries) is re-initialised for the new
+        topology; replicated state — params, model/opt state, the health
+        attempted-step clock, and the host-side supervisor counters —
+        carries over, so fault plans and strike histories stay aligned
+        with the run's step indices. The resize is journalled as a
+        schema-versioned ``remesh`` event naming exactly which state
+        carried vs was re-initialised.
         """
+        old_world = int(self.cfg.num_workers)
         num_workers = int(new_mesh.shape[self.axis_name])
         self.mesh = new_mesh
         self.cfg = dataclasses.replace(self.cfg, num_workers=num_workers)
@@ -566,16 +697,40 @@ class Trainer:
         # params/model/opt state carry over, per-worker state re-initialises
         old = jax.device_get(
             (self.state.params, self.state.model_state, self.state.opt_state))
+        old_health = (jax.device_get(self.state.health)
+                      if self.state.health is not None else None)
         self.state = init_dist_state(
             old[0], old[1], self.optimizer, self.algo_cfg,
             momentum_correction=bool(self._mc_factor), opt_state=old[2],
             num_buckets=self.cfg.num_buckets,
             with_health=self._with_health)
+        carried = ["params", "model_state", "opt_state"]
+        reinit = ["sparse_state", "local_momentum", "autotuner"]
+        if old_health is not None and self.state.health is not None:
+            # the attempted-step counter is the clock every fault plan
+            # and supervisor cadence indexes by — it must stay monotonic
+            # across the resize, not restart at 0
+            self.state = self.state.replace(health=old_health)
+            carried.append("health")
+        elif self.state.health is not None:
+            reinit.append("health")
+        if self.supervisor is not None:
+            carried.append("supervisor")
         # trial measurements were taken on the old topology: drop the
         # tuner (it re-tunes against the new mesh on the next cadence)
         # but keep the current plan so the rebuilt step stays consistent
         self.autotuner = None
         self.step_fn = self._build_step()
+        ev = dict(step=int(step if step is not None
+                           else getattr(self, "last_step", 0)),
+                  old_world=old_world, new_world=num_workers,
+                  trigger=str(trigger),
+                  dead_workers=[int(w) for w in dead_workers],
+                  carried=carried, reinitialised=reinit)
+        if self.supervisor is not None:
+            self.supervisor.journal.remesh(**ev)
+        elif self.bus is not None:
+            self.bus.emit("remesh", **ev)
 
     # ---- eval ---------------------------------------------------------
 

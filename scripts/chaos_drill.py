@@ -1,0 +1,75 @@
+#!/usr/bin/env python
+"""Run deterministic chaos drills against the emulated multi-worker mesh.
+
+Usage:
+    python scripts/chaos_drill.py --list
+    python scripts/chaos_drill.py --drill chip_loss
+    python scripts/chaos_drill.py --drill all --json
+
+Each drill scripts one incident (chip loss, sustained latency, guard
+pressure) end-to-end through the real trainer — real jitted steps, real
+collectives on an emulated 8-worker CPU mesh, a deterministic
+``FaultPlan`` — and checks both the recovery outcome and the journalled
+incident timeline. The catalog lives in
+``oktopk_tpu/resilience/drills.py`` and is the same code the
+``chaos``-marked tests run, so a green drill here means the CI
+scenario passes too.
+
+Exit status is 0 only when every requested drill passes every check.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+# the drills need a multi-device mesh; force 8 virtual CPU devices
+# BEFORE jax is imported (same preamble as tests/conftest.py)
+os.environ.setdefault("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in os.environ["XLA_FLAGS"]:
+    os.environ["XLA_FLAGS"] += " --xla_force_host_platform_device_count=8"
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--drill", default="all",
+                    help="drill name from the catalog, or 'all'")
+    ap.add_argument("--list", action="store_true",
+                    help="list available drills and exit")
+    ap.add_argument("--json", action="store_true",
+                    help="emit one JSON line per drill instead of text")
+    args = ap.parse_args(argv)
+
+    from oktopk_tpu.resilience.drills import DRILLS, run_drill
+
+    if args.list:
+        for name, fn in sorted(DRILLS.items()):
+            doc = (fn.__doc__ or "").strip().split("\n")[0]
+            print(f"{name:<18} {doc}")
+        return 0
+
+    names = sorted(DRILLS) if args.drill == "all" else [args.drill]
+    all_ok = True
+    for name in names:
+        report = run_drill(name)
+        all_ok = all_ok and report.ok
+        if args.json:
+            print(json.dumps({
+                "drill": report.name, "ok": report.ok,
+                "checks": [{"name": n, "passed": p, "detail": d}
+                           for n, p, d in report.checks],
+                "notes": {k: v for k, v in report.notes.items()
+                          if isinstance(v, (int, float, str, list))},
+                "journal_events": len(report.journal)}))
+        else:
+            print(report.summary())
+    return 0 if all_ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

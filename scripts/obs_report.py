@@ -12,7 +12,8 @@ Sections (each omitted when the journal has no matching events):
 - autotune decision log (per-bucket chosen algorithm + reason)
 - host phase table (latest ``phase`` event)
 - incident timeline: faults, guard trips, fallbacks, restores,
-  checkpoints, trace captures and regressions in step order
+  checkpoints, trace captures, regressions, remeshes, forced re-tunes
+  and density backoffs in step order
 
 Works on any JSONL journal that validates against
 ``oktopk_tpu.obs.events`` (see docs/OBSERVABILITY.md).
@@ -30,7 +31,8 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 # events rendered on the incident timeline, in journal order
 _INCIDENT_EVENTS = ("fault_seen", "guard_trip", "fallback", "restore",
                     "restore_unavailable", "checkpoint",
-                    "trace_captured", "regression")
+                    "trace_captured", "regression", "remesh", "retune",
+                    "density_backoff")
 
 
 def _fmt_bytes(b: float) -> str:
@@ -152,6 +154,17 @@ def _timeline_lines(entries: List[Dict[str, Any]]) -> List[str]:
             detail = (f"{e.get('num_steps')} steps from "
                       f"{e.get('start_step')} -> {e.get('logdir')} "
                       f"[{e.get('trigger')}]")
+        elif ev == "remesh":
+            detail = (f"world {e.get('old_world')} -> "
+                      f"{e.get('new_world')} [{e.get('trigger')}] "
+                      f"dead={e.get('dead_workers', [])}")
+        elif ev == "retune":
+            detail = (f"forced re-tune [{e.get('trigger')}] "
+                      f"signals={e.get('signals', [])}")
+        elif ev == "density_backoff":
+            detail = (f"{e.get('direction')} to level {e.get('level')} "
+                      f"(x{e.get('scale', 1):.3f} density) "
+                      f"[{e.get('trigger', '')}]")
         else:  # regression
             detail = (f"{e.get('ms', 0):.1f}ms vs baseline "
                       f"{e.get('baseline_ms', 0):.1f}ms "

@@ -1,0 +1,158 @@
+"""Chaos-drill suite: scripted incidents through the full closed loop.
+
+Each drill in ``oktopk_tpu/resilience/drills.py`` runs a deterministic
+incident end-to-end on the emulated mesh and asserts BOTH the recovery
+outcome and the journalled incident timeline (the same catalog
+``scripts/chaos_drill.py`` exposes to operators). One quick drill per
+scenario runs in tier-1 under the ``chaos`` marker; the unit tests for
+the two host-side policies (AutotuneFeedback, DensityBackoff) stay
+unmarked and sub-second.
+"""
+
+import numpy as np
+import pytest
+
+from oktopk_tpu.obs.journal import EventBus
+from oktopk_tpu.resilience import AutotuneFeedback, DensityBackoff
+from oktopk_tpu.resilience.drills import DRILLS, run_drill
+
+
+# ---------------------------------------------------------------------------
+# host-side policy units (fast, unmarked)
+
+
+class TestAutotuneFeedback:
+    def _fb(self, **kw):
+        bus = EventBus()
+        kw.setdefault("window_steps", 10)
+        kw.setdefault("min_signals", 3)
+        kw.setdefault("cooldown_steps", 20)
+        return bus, AutotuneFeedback(bus, **kw)
+
+    def test_fires_on_sustained_signal_stream(self):
+        bus, fb = self._fb()
+        for step in (4, 5, 6):
+            bus.emit("regression", step=step, ms=20.0, baseline_ms=10.0,
+                     ratio=2.0)
+        trig = fb.should_retune(6)
+        assert trig is not None
+        assert trig["trigger"] == "regression"
+        assert trig["signals"] == [4, 5, 6]
+        assert fb.fired == 1
+
+    def test_needs_min_signals_within_window(self):
+        bus, fb = self._fb()
+        bus.emit("regression", step=1, ms=20.0, baseline_ms=10.0, ratio=2.0)
+        bus.emit("regression", step=2, ms=20.0, baseline_ms=10.0, ratio=2.0)
+        assert fb.should_retune(2) is None          # only 2 signals
+        # the third lands far outside the window: the old two aged out
+        bus.emit("regression", step=30, ms=20.0, baseline_ms=10.0,
+                 ratio=2.0)
+        assert fb.should_retune(30) is None
+        assert fb.fired == 0
+
+    def test_cooldown_blocks_refire_and_consumes_evidence(self):
+        bus, fb = self._fb()
+        for step in (1, 2, 3):
+            bus.emit("guard_trip", step=step, buckets=[0],
+                     consecutive_skips=1, strikes=[1])
+        assert fb.should_retune(3) is not None
+        for step in (4, 5, 6):
+            bus.emit("guard_trip", step=step, buckets=[0],
+                     consecutive_skips=1, strikes=[1])
+        assert fb.should_retune(6) is None          # in cooldown
+        assert fb.fired == 1
+
+    def test_ignores_other_events_and_missing_steps(self):
+        bus, fb = self._fb(min_signals=1)
+        bus.emit("step", step=1, loss=1.0)
+        bus.emit("fallback", step=2, bucket=0, algo="dense", strikes=3)
+        assert fb.should_retune(2) is None
+
+
+class TestDensityBackoff:
+    def test_backs_off_after_n_pressured_steps(self):
+        db = DensityBackoff(abs_limit=100.0, near_ratio=0.5,
+                            backoff_steps=3, factor=0.5, max_level=2,
+                            clean_streak=4)
+        assert db.observe(1, absmax=80.0) is None       # near: 80 > 50
+        assert db.observe(2, absmax=80.0) is None
+        change = db.observe(3, absmax=80.0)
+        assert change == {"direction": "backoff", "level": 1,
+                          "scale": 0.5, "trigger": "near_abs_limit"}
+        assert db.scale == 0.5
+
+    def test_guard_skip_counts_as_pressure_and_nan_is_safe(self):
+        db = DensityBackoff(abs_limit=100.0, backoff_steps=2)
+        assert db.observe(1, absmax=float("nan"), skipped=1) is None
+        change = db.observe(2, absmax=float("nan"), skipped=1)
+        assert change["trigger"] == "guard_skip"
+
+    def test_bounded_and_hysteretic(self):
+        db = DensityBackoff(abs_limit=100.0, near_ratio=0.5,
+                            backoff_steps=2, factor=0.5, max_level=2,
+                            clean_streak=3)
+        assert db.observe(1, absmax=90.0) is None
+        assert db.observe(2, absmax=90.0)["level"] == 1
+        assert db.observe(3, absmax=90.0) is None
+        assert db.observe(4, absmax=90.0)["level"] == 2
+        assert db.observe(5, absmax=90.0) is None       # bounded at max
+        assert db.observe(6, absmax=90.0) is None
+        assert db.level == 2 and db.scale == 0.25
+        # a clean streak re-advances one level at a time
+        assert db.observe(7, absmax=1.0) is None
+        assert db.observe(8, absmax=1.0) is None
+        adv = db.observe(9, absmax=1.0)
+        assert adv == {"direction": "advance", "level": 1, "scale": 0.5,
+                       "trigger": "clean_streak"}
+        # one pressured step resets the clean streak (hysteresis) but is
+        # not enough evidence on its own to back off again
+        assert db.observe(10, absmax=1.0) is None
+        assert db.observe(11, absmax=90.0) is None
+        assert db.level == 1
+        assert db.observe(12, absmax=1.0) is None
+        assert db.observe(13, absmax=1.0) is None
+        assert db.observe(14, absmax=1.0)["level"] == 0
+
+    def test_validation(self):
+        with pytest.raises(ValueError):
+            DensityBackoff(abs_limit=100.0, factor=1.5)
+        with pytest.raises(ValueError):
+            DensityBackoff(abs_limit=100.0, backoff_steps=0)
+
+
+# ---------------------------------------------------------------------------
+# end-to-end drills (emulated mesh, chaos-marked, one per scenario)
+
+
+@pytest.mark.chaos
+class TestDrills:
+    def test_catalog_complete(self):
+        assert set(DRILLS) == {"chip_loss", "latency_retune",
+                               "density_backoff"}
+        with pytest.raises(KeyError):
+            run_drill("meteor_strike")
+
+    def test_chip_loss_drill(self, mesh8):
+        """Chip dies at step k -> supervisor emits remesh -> training
+        resumes on the shrunk mesh, params bit-identical across the
+        resize, journalled chain fault_seen -> remesh -> next step."""
+        report = run_drill("chip_loss", mesh=mesh8)
+        assert report.ok, "\n" + report.summary()
+
+    def test_latency_retune_drill(self, mesh4):
+        """Sustained latency fault -> regression stream -> forced
+        re-calibrate + re-tune -> plan flips to the latency-tolerant
+        algorithm and step time recovers."""
+        report = run_drill("latency_retune", mesh=mesh4)
+        assert report.ok, "\n" + report.summary()
+
+    def test_density_backoff_drill(self, mesh4):
+        """Guard-pressure streak -> bounded hysteretic density backoff,
+        journalled; clean streak re-advances; the unguarded contrast
+        run diverges."""
+        report = run_drill("density_backoff", mesh=mesh4)
+        assert report.ok, "\n" + report.summary()
+        assert report.notes["guarded_param_absmax"] < 1e3
+        mx = report.notes["unguarded_param_absmax"]
+        assert (not np.isfinite(mx)) or mx > 1e3

@@ -483,3 +483,155 @@ class TestTrainerRestore:
             tr.supervise(step, skip)
         assert tr.supervisor.restore_events == 1
         assert _leaves_equal(saved, tr.state.params)
+
+# ---------------------------------------------------------------------------
+# PR 6: chip loss, scale_grad, feedback plumbing, elastic resize
+
+
+class TestChipLossAndScaleGrad:
+    def test_chip_loss_spec_validation(self):
+        with pytest.raises(ValueError, match="concrete worker"):
+            FaultSpec("chip_loss", step=3)
+        FaultSpec("chip_loss", step=3, worker=0)  # valid
+
+    def test_dead_workers_is_cumulative_and_sorted(self):
+        from oktopk_tpu.resilience.faults import dead_workers
+        plan = FaultPlan((FaultSpec("chip_loss", step=3, worker=5),
+                          FaultSpec("chip_loss", step=7, worker=1)))
+        assert dead_workers(plan, 2) == ()
+        assert dead_workers(plan, 3) == (5,)
+        assert dead_workers(plan, 7) == (1, 5)   # permanent, sorted
+        assert dead_workers(plan, 99) == (1, 5)
+
+    def test_chip_loss_does_not_touch_gradients(self):
+        plan = FaultPlan((FaultSpec("chip_loss", step=0, worker=0),))
+        assert len(plan.grad_faults) == 0
+        flat = jnp.ones((4,))
+        out = inject_grad_faults(plan, flat, jnp.int32(0), jnp.int32(0), 0)
+        np.testing.assert_array_equal(np.asarray(out), np.asarray(flat))
+
+    def test_scale_grad_is_finite_and_structure_preserving(self):
+        plan = FaultPlan((FaultSpec("scale_grad", step=2, scale=1e6),))
+        flat = jnp.linspace(-1.0, 1.0, 8)
+        hit = inject_grad_faults(plan, flat, jnp.int32(2), jnp.int32(0), 0)
+        miss = inject_grad_faults(plan, flat, jnp.int32(1), jnp.int32(0), 0)
+        np.testing.assert_array_equal(np.asarray(miss), np.asarray(flat))
+        assert bool(jnp.all(jnp.isfinite(hit)))
+        np.testing.assert_allclose(np.asarray(hit),
+                                   np.asarray(flat) * 1e6, rtol=1e-6)
+
+    def test_with_latency_seeds_from_start_step(self):
+        plan = FaultPlan((FaultSpec("latency", step=5, latency_ms=100.0),))
+        slept = []
+        # a resumed run restarts its host clock at the restore step, so
+        # the schedule stays aligned with the replicated health clock
+        wrapped = with_latency(lambda x: x, plan, sleep=slept.append,
+                               start_step=4)
+        wrapped(1)   # host step 4: no fault
+        wrapped(2)   # host step 5: fault fires
+        assert slept == [0.1]
+
+    def test_with_latency_seek_realigns_after_restore(self):
+        plan = FaultPlan((FaultSpec("latency", step=2, latency_ms=100.0),))
+        slept = []
+        wrapped = with_latency(lambda x: x, plan, sleep=slept.append)
+        wrapped(1)          # step 0
+        wrapped(2)          # step 1
+        wrapped.seek(2)     # restore rewinds the host clock
+        wrapped(3)          # step 2: fault fires
+        assert slept == [0.1]
+
+
+class TestSupervisorRemesh:
+    CLEAN = {"step_skipped": 0, "bucket_anomalies": np.zeros(1, np.int32)}
+
+    def test_note_chip_loss_escalates_once_per_worker(self):
+        sup = Supervisor(num_buckets=1, cooldown_steps=100)
+        acts = sup.note_chip_loss(5, [3])
+        assert [a.kind for a in acts] == ["remesh"]
+        assert acts[0].workers == (3,)
+        # idempotent: the same dead set does not re-escalate, and the
+        # cooldown that spaces strike escalations does not apply
+        assert sup.note_chip_loss(6, [3]) == []
+        acts2 = sup.note_chip_loss(7, [3, 6])
+        assert acts2[0].workers == (6,)
+        assert sup.remesh_events == 2
+        assert sup.dead_workers == [3, 6]
+        kinds = [e["event"] for e in sup.journal.entries]
+        assert kinds.count("fault_seen") == 2
+
+    def test_state_roundtrip_carries_remesh_and_cooldown(self):
+        sup = Supervisor(num_buckets=2, max_strikes=2, cooldown_steps=7)
+        sup.observe(1, {"step_skipped": 1,
+                        "bucket_anomalies": np.array([1, 0], np.int32)})
+        sup.note_chip_loss(2, [1])
+        st = sup.to_state()
+        fresh = Supervisor(num_buckets=2, cooldown_steps=7).load_state(st)
+        assert fresh.remesh_events == 1
+        assert fresh.dead_workers == [1]
+        assert fresh.strikes == sup.strikes
+        assert fresh._cooldown_until == sup._cooldown_until
+
+
+class TestElasticResize:
+    def _devices(self, mesh, n):
+        return list(np.asarray(mesh.devices).reshape(-1))[:n]
+
+    def test_resize_carries_supervisor_and_health(self, mesh4):
+        from oktopk_tpu.comm.mesh import get_mesh
+
+        tr = _trainer(mesh4, obs=True)
+        for b in _batches(2, seed=13):
+            tr.train_step(b)
+        tr.supervisor.strikes[0] = 2
+        params_pre = jax.device_get(tr.state.params)
+        health_step_pre = int(np.asarray(
+            jax.device_get(tr.state.health.step)).reshape(-1)[0])
+        small = get_mesh((2,), ("data",),
+                         devices=self._devices(mesh4, 2))
+        tr.resize_workers(small, trigger="manual", step=2)
+        # params bit-identical, supervisor object intact, health clock
+        # carried (fault plans stay aligned across the resize)
+        assert _leaves_equal(params_pre, tr.state.params)
+        assert tr.supervisor.strikes[0] == 2
+        assert tr.cfg.num_workers == 2
+        health_step_post = int(np.asarray(
+            jax.device_get(tr.state.health.step)).reshape(-1)[0])
+        assert health_step_post == health_step_pre
+        ev = [e for e in tr.supervisor.journal.entries
+              if e["event"] == "remesh"]
+        assert len(ev) == 1
+        assert ev[0]["old_world"] == 4 and ev[0]["new_world"] == 2
+        assert ev[0]["trigger"] == "manual"
+        assert "supervisor" in ev[0]["carried"]
+        assert "health" in ev[0]["carried"]
+        assert "autotuner" in ev[0]["reinitialised"]
+        # the shrunk trainer still steps (batch resharded over 2 ranks)
+        rng = np.random.RandomState(21)
+        m = tr.train_step(synthetic_batch("mnistnet", 4, rng))
+        assert np.isfinite(np.asarray(m["loss"])).all()
+
+    def test_supervisor_roundtrip_across_resize_and_checkpoint(
+            self, mesh4, tmp_path):
+        """Satellite: supervisor state survives resize_workers AND the
+        save_checkpoint(extra=)/restore_supervisor path afterwards."""
+        from oktopk_tpu.comm.mesh import get_mesh
+        from oktopk_tpu.train.checkpoint import save_checkpoint
+
+        tr = _trainer(mesh4, num_buckets=2, obs=True)
+        skip = {"step_skipped": np.int32(1),
+                "bucket_anomalies": np.array([0, 1], np.int32)}
+        tr.supervise(1, skip)
+        tr.supervise(2, skip)
+        assert tr.supervisor.strikes[1] == 2
+        small = get_mesh((2,), ("data",),
+                         devices=list(
+                             np.asarray(mesh4.devices).reshape(-1))[:2])
+        tr.resize_workers(small, trigger="manual", step=2)
+        assert tr.supervisor.strikes[1] == 2          # carried, not reset
+        path = save_checkpoint(str(tmp_path), tr.state, step=2,
+                               extra=tr.supervisor_extra())
+        tr2 = _trainer(small, num_buckets=2, obs=True)
+        tr2.restore_supervisor(path)
+        assert tr2.supervisor.strikes == tr.supervisor.strikes
+        assert tr2.supervisor.remesh_events == tr.supervisor.remesh_events
