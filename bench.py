@@ -318,8 +318,11 @@ def step_time_probe(iters=10):
     # numeric-health tail (resilience/): a few guarded oktopk steps so the
     # bench driver tracks numeric health alongside latency — steps_skipped
     # and fallback_events must be 0 on a healthy chip, and grad_nonfinite
-    # flags the blow-up step when they are not. Last in the priority
-    # order: a deadline kill here costs no timing.
+    # flags the blow-up step when they are not. The durable-state leg
+    # rides along: one save+verify round trip through the
+    # AsyncCheckpointer, so ckpt_saves tracks that the storage path
+    # publishes verified checkpoints (ckpt_verify_failures must be 0).
+    # Last in the priority order: a deadline kill here costs no timing.
     try:
         cfg = TrainConfig(dnn="vgg16", dataset="cifar10", batch_size=16,
                           lr=0.1, compressor="oktopk", density=0.02,
@@ -334,6 +337,16 @@ def step_time_probe(iters=10):
         out["fallback_events"] = trainer.supervisor.fallback_events
         out["remesh_events"] = trainer.supervisor.remesh_events
         out["retune_events"] = trainer.retune_events
+        import tempfile as _tempfile
+
+        from oktopk_tpu.train.durable import AsyncCheckpointer
+        with _tempfile.TemporaryDirectory() as ckpt_dir:
+            with AsyncCheckpointer(ckpt_dir) as ckpt:
+                ckpt.save(trainer.state, 2,
+                          qualified=trainer.checkpoint_qualified)
+                ckpt.drain(timeout=120.0)
+            out["ckpt_saves"] = ckpt.saves
+            out["ckpt_verify_failures"] = ckpt.verify_failures
         print("STEP_PROBE " + json.dumps(out), flush=True)
     except Exception as e:
         print(f"[bench] resilience probe failed: {e!r}", file=sys.stderr)
@@ -409,7 +422,8 @@ def main():
                     "mfu_dense", "mfu_oktopk", "mfu_dense_bs256",
                     "mfu_oktopk_bs256", "mfu_dense_bf16_bs256",
                     "grad_nonfinite", "steps_skipped", "fallback_events",
-                    "remesh_events", "retune_events"):
+                    "remesh_events", "retune_events",
+                    "ckpt_saves", "ckpt_verify_failures"):
             if key in steps:
                 rec[key] = (round(steps[key], 3)
                             if isinstance(steps[key], float)

@@ -136,6 +136,29 @@ EVENT_SCHEMAS: Dict[str, Dict[str, Dict[str, tuple]]] = {
         "required": {"step": _NUM, "path": _STR, "qualified": _BOOL},
         "optional": {},
     },
+    # durable state plane (train/durable.py): a checkpoint file was
+    # written AND verified against its manifest ("source" says whether
+    # the AsyncCheckpointer or a synchronous save published it)
+    "ckpt_saved": {
+        "required": {"step": _NUM, "path": _STR},
+        "optional": {"bytes": _NUM, "digest": _STR, "qualified": _BOOL,
+                     "duration_ms": _NUM, "source": _STR},
+    },
+    # a checkpoint file failed verification (digest/size mismatch, torn
+    # or failed write, undecodable legacy file) — restore skips it and
+    # falls back to the next-older candidate
+    "ckpt_verify_failed": {
+        "required": {"step": _NUM, "path": _STR, "reason": _STR},
+        "optional": {},
+    },
+    # a verified restore completed; fallback_depth counts the newer
+    # corrupt checkpoints skipped to reach this one, legacy flags a
+    # manifest-less file accepted unverified
+    "ckpt_restore": {
+        "required": {"step": _NUM, "path": _STR},
+        "optional": {"ckpt_step": _NUM, "fallback_depth": _NUM,
+                     "legacy": _BOOL},
+    },
     # bounded profiler window closed (obs/tracing.py AnomalyTracer)
     "trace_captured": {
         "required": {"step": _NUM, "start_step": _NUM,

@@ -27,6 +27,12 @@ config change is byte-for-byte the drill CI runs:
 - ``density_backoff`` — repeated guard-pressure steps back the
   effective density off hysteretically, then a clean streak re-advances
   it; the same fault without the guard diverges (the contrast case).
+- ``ckpt_corruption`` — the supervisor's restore target is damaged at
+  rest (each of truncate / bitflip / torn); the divergence-triggered
+  restore must fall back to the older *verified* checkpoint
+  bit-identically, with ``ckpt_verify_failed`` preceding ``restore`` in
+  the journal — plus the async-save drain and legacy-checkpoint
+  contracts of the durable state plane (train/durable.py).
 """
 
 from __future__ import annotations
@@ -440,12 +446,202 @@ def drill_density_backoff(mesh=None, clean_before: int = 3,
     return DrillReport("density_backoff", checks, journal, notes=notes)
 
 
+# ---- drill: corrupt restore target → verified fallback -------------------
+
+def drill_ckpt_corruption(mesh=None, per_worker_bs: int = 2,
+                          kinds: Tuple[str, ...] = ("ckpt_truncate",
+                                                    "ckpt_bitflip",
+                                                    "ckpt_torn"),
+                          ckpt_dir: Optional[str] = None) -> DrillReport:
+    """The storage leg of the self-healing loop: checkpoint A (older,
+    good) and B (newer, the supervisor's restore target) are saved
+    through the :class:`~oktopk_tpu.train.durable.AsyncCheckpointer`;
+    B is then damaged at rest with each ``ckpt_*`` fault kind in turn
+    while a NaN fault drives the run to divergence. Every
+    divergence-triggered restore must *skip* corrupt B and land on A
+    bit-identically (params, residual, health — the whole state tree),
+    with the journal showing ``ckpt_verify_failed(B)`` before the
+    ``restore`` record naming A. A restore rewinds the replicated
+    attempted-step clock, so the same NaN window re-fires after each
+    restore — one fault spec drives all three corruption rounds. The
+    drill also proves the satellite contracts: an async save in flight
+    is drained whole (no torn file), an aged ``*.tmp`` remnant is swept
+    by the checkpoint scan, and a legacy manifest-less checkpoint still
+    restores (flagged, not rejected)."""
+    import os
+    import shutil
+    import tempfile
+
+    from oktopk_tpu.comm.mesh import get_mesh
+    from oktopk_tpu.resilience.faults import corrupt_checkpoint
+    from oktopk_tpu.train.checkpoint import (latest_checkpoint,
+                                             save_checkpoint)
+    from oktopk_tpu.train.durable import (AsyncCheckpointer,
+                                          verified_restore,
+                                          verify_checkpoint)
+
+    mesh = mesh if mesh is not None else get_mesh()
+    P = int(mesh.shape["data"])
+    div_limit = 3
+    # attempted-step clock counts from 0: host steps 1..4 run attempted
+    # 0..3 (clean), attempted >= 4 is the NaN window. A is saved after
+    # host step 2 (clock 2), so each post-restore cycle replays 2 clean
+    # steps then hits the window again.
+    plan = FaultPlan((FaultSpec("nan_grad", step=4, duration=10_000),))
+    tr = _drill_trainer(mesh, fault_plan=plan,
+                        resilience_divergence_limit=div_limit,
+                        resilience_strikes=99)
+    checks: List[Tuple[str, bool, str]] = []
+    own_dir = ckpt_dir is None
+    ckpt_dir = ckpt_dir or tempfile.mkdtemp(prefix="oktopk-ckpt-drill-")
+    ac = AsyncCheckpointer(ckpt_dir, journal=tr.supervisor.journal,
+                           on_failure=tr.note_ckpt_failure)
+    batches = _batches(DEFAULT_DNN, P * per_worker_bs)
+    losses: List[float] = []
+
+    def host_step(step: int):
+        m = tr.train_step(next(batches))
+        losses.append(float(np.asarray(m["loss"]).mean()))
+        tr.bus.emit("step", step=step, loss=losses[-1],
+                    step_skipped=int(np.asarray(m.get("step_skipped", 0))))
+        tr.supervise(step, m)
+        return m
+
+    try:
+        step = 0
+        snap_a = path_a = path_b = None
+        for _ in range(4):
+            step += 1
+            host_step(step)
+            if step in (2, 4):
+                path = ac.save(tr.state, step, extra=tr.supervisor_extra(),
+                               qualified=tr.checkpoint_qualified)
+                ac.drain()
+                tr.note_checkpoint(path, step)
+                if step == 2:
+                    path_a, snap_a = path, jax.device_get(tr.state)
+                else:
+                    path_b = path
+        _check(checks, "saves_verified",
+               ac.saves == 2 and ac.write_failures == 0
+               and tr.supervisor.last_good_ckpt == path_b,
+               f"saves={ac.saves} failures={ac.write_failures} "
+               f"target={tr.supervisor.last_good_ckpt}")
+        with open(path_b, "rb") as f:
+            pristine_b = f.read()
+        man_b = path_b[: -len(".msgpack")] + ".manifest.json"
+        with open(man_b, "rb") as f:
+            pristine_man_b = f.read()
+
+        identical: List[bool] = []
+        for i, kind in enumerate(kinds):
+            if i:  # re-pristine B so the next kind damages a clean file
+                with open(path_b, "wb") as f:
+                    f.write(pristine_b)
+                with open(man_b, "wb") as f:
+                    f.write(pristine_man_b)
+            corrupt_checkpoint(path_b, kind)
+            safety = 0
+            while tr.supervisor.restore_events < i + 1 and safety < 12:
+                step += 1
+                safety += 1
+                host_step(step)
+            identical.append(_leaves_equal(jax.device_get(tr.state),
+                                           snap_a))
+        # post-incident recovery: the two clean steps after the rewind
+        for _ in range(2):
+            step += 1
+            host_step(step)
+
+        journal = list(tr.run_journal.entries)
+        n = len(kinds)
+        idx_vf = _event_indices(journal, "ckpt_verify_failed",
+                                path=path_b)
+        idx_cr = _event_indices(journal, "ckpt_restore", path=path_a)
+        idx_rs = _event_indices(journal, "restore", ckpt=path_a)
+        reasons = [journal[i]["reason"] for i in idx_vf]
+        _check(checks, "restores_fired",
+               tr.supervisor.restore_events == n and len(idx_rs) == n,
+               f"restore_events={tr.supervisor.restore_events}, "
+               f"{len(idx_rs)} restore records for A")
+        _check(checks, "verify_failed_precedes_restore",
+               len(idx_vf) >= n and len(idx_cr) == n
+               and all(idx_vf[i] < idx_cr[i] < idx_rs[i]
+                       for i in range(min(n, len(idx_rs)))),
+               f"verify_failed@{idx_vf} ckpt_restore@{idx_cr} "
+               f"restore@{idx_rs}")
+        expected = {"ckpt_truncate": "size_mismatch",
+                    "ckpt_bitflip": "digest_mismatch",
+                    "ckpt_torn": "size_mismatch"}
+        _check(checks, "rejection_reasons",
+               len(reasons) >= n
+               and all(reasons[i].startswith(expected[k])
+                       for i, k in enumerate(kinds)),
+               f"reasons={reasons}")
+        _check(checks, "fallback_depth_one",
+               all(journal[i].get("fallback_depth") == 1
+                   and journal[i].get("legacy") is False
+                   for i in idx_cr),
+               f"ckpt_restore events: {[journal[i] for i in idx_cr]}")
+        _check(checks, "state_bit_identical",
+               len(identical) == n and all(identical),
+               f"rounds identical to A: {identical}")
+        _check(checks, "recovered",
+               all(np.isfinite(losses[-2:])),
+               f"post-restore losses {losses[-2:]}")
+
+        # drain barrier: an async save in flight at (simulated)
+        # preemption time publishes whole — verified file, no tmp
+        final = ac.save(tr.state, step, qualified=tr.checkpoint_qualified)
+        drained = ac.drain(timeout=60.0)
+        _check(checks, "drain_publishes_whole",
+               drained and verify_checkpoint(final).ok
+               and not os.path.exists(final + ".tmp"),
+               f"drained={drained}")
+
+        # the torn round's stale tmp remnant: fresh tmp files survive
+        # the scan (an async writer may own them); aged ones are swept
+        remnant = path_b + ".tmp"
+        had_remnant = os.path.exists(remnant)
+        if had_remnant:
+            os.utime(remnant, (0, 0))
+        latest_checkpoint(ckpt_dir)
+        _check(checks, "stale_tmp_swept",
+               had_remnant and not os.path.exists(remnant),
+               f"remnant existed={had_remnant}, "
+               f"still there={os.path.exists(remnant)}")
+
+        # legacy manifest-less checkpoint: accepted with the flag set
+        legacy_dir = os.path.join(ckpt_dir, "legacy")
+        save_checkpoint(legacy_dir, tr.state, 1, manifest=False)
+        _, lstep, _, _, legacy = verified_restore(
+            legacy_dir, tr.state, journal=tr.supervisor.journal,
+            step=step)
+        _check(checks, "legacy_restores", legacy and lstep == 1,
+               f"legacy={legacy} step={lstep}")
+
+        journal = list(tr.run_journal.entries)
+        problems = validate_journal(journal)
+        _check(checks, "journal_valid", not problems,
+               "; ".join(problems[:3]))
+        return DrillReport(
+            "ckpt_corruption", checks, journal,
+            notes={"kinds": list(kinds), "reasons": reasons,
+                   "losses": losses,
+                   "ckpts": {"a": path_a, "b": path_b}})
+    finally:
+        ac.close(timeout=60.0)
+        if own_dir:
+            shutil.rmtree(ckpt_dir, ignore_errors=True)
+
+
 # ---- catalog ------------------------------------------------------------
 
 DRILLS: Dict[str, Callable[..., DrillReport]] = {
     "chip_loss": drill_chip_loss,
     "latency_retune": drill_latency_retune,
     "density_backoff": drill_density_backoff,
+    "ckpt_corruption": drill_ckpt_corruption,
 }
 
 

@@ -41,6 +41,16 @@ Five fault families, mirroring what degrades in real sparse pipelines:
   escalates it to a ``remesh`` action that drives
   ``Trainer.resize_workers`` onto the surviving devices. ``duration``
   is ignored: chips do not come back mid-run.
+- ``ckpt_truncate`` / ``ckpt_bitflip`` / ``ckpt_torn``: a checkpoint
+  *file* is damaged at rest — the storage-leg failures the durable
+  state plane (``train/durable.py``) exists to survive. Host-side only
+  (:func:`corrupt_checkpoint` mutates the file deterministically;
+  never traced): truncation models a crashed writer or lost tail,
+  bitflip models at-rest bit rot with the size preserved (only the
+  digest catches it), and torn models a non-atomic writer dying
+  mid-publish — a prefix in the final file plus a stale ``*.tmp``
+  remnant. The chaos drills corrupt the supervisor's restore target and
+  assert the verifying restore falls back to the older good file.
 """
 
 from __future__ import annotations
@@ -53,7 +63,8 @@ import jax.numpy as jnp
 from jax import lax
 
 FAULT_KINDS = ("nan_grad", "inf_grad", "scale_grad", "wire_bitflip",
-               "wire_zero", "latency", "chip_loss")
+               "wire_zero", "latency", "chip_loss",
+               "ckpt_truncate", "ckpt_bitflip", "ckpt_torn")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -120,6 +131,10 @@ class FaultPlan:
     @property
     def latency_faults(self) -> Tuple[FaultSpec, ...]:
         return self.of_kind("latency")
+
+    @property
+    def ckpt_faults(self) -> Tuple[FaultSpec, ...]:
+        return self.of_kind("ckpt_truncate", "ckpt_bitflip", "ckpt_torn")
 
 
 def _active(spec: FaultSpec, step, rank):
@@ -271,3 +286,43 @@ def degraded_fake_ms(base: Callable[[str, int, float], float],
         return float(base(algo, n, density)) + latency_ms(plan, step, b)
 
     return fake
+
+
+def corrupt_checkpoint(path: str, kind: str, bit_mask: int = 0x40,
+                       offset: int = -1) -> None:
+    """Deterministically damage a checkpoint file at rest (host-side;
+    the drill seam for the ``ckpt_*`` fault kinds).
+
+    - ``ckpt_truncate``: the file becomes its leading half — a crashed
+      writer or lost tail; caught by the manifest size check.
+    - ``ckpt_bitflip``: one byte (middle of the file, or ``offset``) is
+      XORed with ``bit_mask`` — at-rest bit rot. The size is preserved,
+      so only the digest catches it.
+    - ``ckpt_torn``: a non-atomic writer died mid-publish — the final
+      file holds a prefix AND a stale ``<path>.tmp`` remnant is left
+      behind (size check catches the file; the stale-tmp sweep collects
+      the remnant).
+
+    The sidecar manifest is left intact on purpose: the corruption is in
+    the data, and the manifest is what convicts it.
+    """
+    if kind not in ("ckpt_truncate", "ckpt_bitflip", "ckpt_torn"):
+        raise ValueError(f"not a checkpoint fault kind: {kind!r}")
+    with open(path, "rb") as f:
+        data = f.read()
+    if len(data) < 2:
+        raise ValueError(f"checkpoint {path} too small to corrupt")
+    if kind == "ckpt_truncate":
+        with open(path, "wb") as f:
+            f.write(data[: len(data) // 2])
+    elif kind == "ckpt_bitflip":
+        buf = bytearray(data)
+        i = offset if 0 <= offset < len(buf) else len(buf) // 2
+        buf[i] ^= (bit_mask & 0xFF) or 0x40
+        with open(path, "wb") as f:
+            f.write(bytes(buf))
+    else:  # ckpt_torn
+        with open(path, "wb") as f:
+            f.write(data[: max(1, 2 * len(data) // 3)])
+        with open(path + ".tmp", "wb") as f:
+            f.write(data[: max(1, len(data) // 3)])

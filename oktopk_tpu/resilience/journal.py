@@ -28,6 +28,16 @@ containers/relays. Events (all carry ``event`` and ``step``):
 
   {"event": "density_backoff", "step": 52, "direction": "backoff",
    "level": 1, "scale": 0.5, "trigger": "guard_skip"}
+
+  {"event": "ckpt_saved", "step": 60, "path": ".../ckpt-60.msgpack",
+   "bytes": 123456, "digest": "crc32:0a1b2c3d", "qualified": true,
+   "source": "async"}
+
+  {"event": "ckpt_verify_failed", "step": 66, "path": "...",
+   "reason": "digest_mismatch"}
+
+  {"event": "ckpt_restore", "step": 66, "path": ".../ckpt-54.msgpack",
+   "ckpt_step": 54, "fallback_depth": 1, "legacy": false}
 """
 
 from __future__ import annotations
@@ -87,3 +97,27 @@ class HealthJournal(DecisionJournal):
         return self.record("density_backoff", step=int(step),
                            direction=str(direction), level=int(level),
                            scale=float(scale), trigger=str(trigger))
+
+    # ---- durable state plane (train/durable.py) ----------------------
+
+    def ckpt_saved(self, step: int, path: str, nbytes: int = 0,
+                   digest: str = "", qualified: bool = True,
+                   duration_ms: Optional[float] = None,
+                   source: str = "sync"):
+        fields = dict(step=int(step), path=str(path), bytes=int(nbytes),
+                      digest=str(digest), qualified=bool(qualified),
+                      source=str(source))
+        if duration_ms is not None:
+            fields["duration_ms"] = float(duration_ms)
+        return self.record("ckpt_saved", **fields)
+
+    def ckpt_verify_failed(self, step: int, path: str, reason: str):
+        return self.record("ckpt_verify_failed", step=int(step),
+                           path=str(path), reason=str(reason))
+
+    def ckpt_restore(self, step: int, path: str, ckpt_step: int = 0,
+                     fallback_depth: int = 0, legacy: bool = False):
+        return self.record("ckpt_restore", step=int(step), path=str(path),
+                           ckpt_step=int(ckpt_step),
+                           fallback_depth=int(fallback_depth),
+                           legacy=bool(legacy))

@@ -78,6 +78,7 @@ class Supervisor:
         self.fallback_events = 0
         self.restore_events = 0
         self.remesh_events = 0
+        self.ckpt_write_failures = 0
         self.dead_workers: List[int] = []
         self._cooldown_until = -1
 
@@ -151,13 +152,34 @@ class Supervisor:
         if (self.consecutive_skips >= self.divergence_limit
                 and step >= self._cooldown_until):
             self.restore_events += 1
-            self.journal.restore(step, self.last_good_ckpt,
-                                 self.last_good_step)
+            if self.last_good_ckpt is None:
+                # nothing to verify or execute: journal right here
+                self.journal.restore(step, None, self.last_good_step)
+            # a successful restore is journalled by the trainer AFTER
+            # checkpoint verification, so ckpt_verify_failed events for
+            # a corrupt target precede the restore record and the
+            # journal names the file actually loaded, not the intended
+            # one (train/durable.py verified_restore)
             actions.append(Action("restore", ckpt=self.last_good_ckpt))
             # the restore (or its unavailability) consumed this evidence
             self.consecutive_skips = 0
             self._cooldown_until = step + self.cooldown_steps
         return actions
+
+    def note_ckpt_write_failure(self, step: int, path: str,
+                                error: Any) -> None:
+        """An async (or sync) checkpoint save failed to write or verify.
+        The writer (``durable.AsyncCheckpointer``) already journalled the
+        ``ckpt_verify_failed``; here the failure is *counted* and — if
+        the failed file was the registered restore target — the
+        registration is dropped, so a later divergence restore falls
+        back to the previous good checkpoint instead of chasing a file
+        that never published."""
+        del error  # journalled by the writer
+        self.ckpt_write_failures += 1
+        if self.last_good_ckpt == path:
+            self.last_good_ckpt = None
+            self.last_good_step = -1
 
     # ---- checkpointable state ----------------------------------------
 
@@ -172,6 +194,7 @@ class Supervisor:
             "fallback_events": int(self.fallback_events),
             "restore_events": int(self.restore_events),
             "remesh_events": int(self.remesh_events),
+            "ckpt_write_failures": int(self.ckpt_write_failures),
             "dead_workers": [int(w) for w in self.dead_workers],
             "cooldown_until": int(self._cooldown_until),
         }
@@ -198,6 +221,7 @@ class Supervisor:
         self.fallback_events = int(state.get("fallback_events", 0))
         self.restore_events = int(state.get("restore_events", 0))
         self.remesh_events = int(state.get("remesh_events", 0))
+        self.ckpt_write_failures = int(state.get("ckpt_write_failures", 0))
         self.dead_workers = [int(w) for w in np.asarray(
             state.get("dead_workers", [])).reshape(-1).tolist()]
         self._cooldown_until = int(state.get("cooldown_until", -1))

@@ -10,6 +10,13 @@ dicts, VGG/compression.py:28,170) — a resume silently resets error feedback
 batch stats, residual, thresholds, boundaries, step counters — is one pytree,
 serialised with flax msgpack.
 
+Durability (``oktopk_tpu.train.durable``): every save publishes atomically
+(tmp file -> fsync -> ``os.replace`` -> dir fsync) and writes a sidecar
+manifest with a digest of the bytes; ``restore_checkpoint`` verifies by
+default and walks newest -> oldest past corrupt files. Reads go through a
+small mtime-keyed cache so ``restore_checkpoint`` + ``load_extra`` on the
+same file decode once.
+
 Preemption (save-on-signal -> requeue, reference
 BERT/bert/main_bert.py:73-153) lives in ``oktopk_tpu.train.preemption``.
 """
@@ -17,55 +24,107 @@ BERT/bert/main_bert.py:73-153) lives in ``oktopk_tpu.train.preemption``.
 from __future__ import annotations
 
 import json
+import logging
 import os
-from typing import Any, Optional, Tuple
+import threading
+from typing import Any, Dict, Optional, Tuple
 
 import flax.serialization
 import jax
 import numpy as np
 
+from oktopk_tpu.train import durable
+
+_log = logging.getLogger("oktopk_tpu")
+
+# Above this fraction of mismatched leaves the checkpoint is almost
+# certainly for a different --model/config, and restore raises instead
+# of silently training a mostly-fresh model (force=True downgrades the
+# raise back to the warning).
+MERGE_ESCALATION_FRAC = 0.5
+
 
 def save_checkpoint(ckpt_dir: str, state: Any, step: int,
                     prefix: str = "ckpt",
-                    extra: Optional[dict] = None) -> str:
+                    extra: Optional[dict] = None,
+                    qualified: bool = True,
+                    manifest: bool = True) -> str:
     """Serialise the full train state to ``<ckpt_dir>/<prefix>-<step>.msgpack``.
 
     ``extra`` is an optional side payload of plain scalars/lists (e.g.
     the resilience supervisor's strike counters and fallback plan,
     ``Trainer.supervisor_extra``) stored under its own key — it never
     participates in the train-state pytree merge and is read back with
-    :func:`load_extra`."""
+    :func:`load_extra`.
+
+    The data file is published atomically with fsync on the tmp file and
+    the directory (no torn-write window), then the sidecar manifest
+    (digest, size, environment fingerprint, ``qualified`` bit) is
+    published the same way — a crash in between leaves a fully-written
+    but manifest-less file, which restore accepts as legacy.
+    ``qualified=False`` marks a mid-incident checkpoint (skips in
+    flight) that retention may collect but the supervisor will not
+    restore-target; ``manifest=False`` reproduces the legacy format
+    (tests only)."""
     os.makedirs(ckpt_dir, exist_ok=True)
     host_state = jax.device_get(state)
-    path = os.path.join(ckpt_dir, f"{prefix}-{step}.msgpack")
-    payload = {"step": step, "state": host_state}
+    path = os.path.join(ckpt_dir, f"{prefix}-{int(step)}.msgpack")
+    payload = {"step": int(step), "state": host_state}
     if extra:
         # JSON-encoded: flax's to_state_dict would rewrite lists into
         # index-keyed dicts, and the payload is plain scalars anyway
         payload["extra"] = json.dumps(extra)
-    tmp = path + ".tmp"
-    with open(tmp, "wb") as f:
-        f.write(flax.serialization.to_bytes(payload))
-    os.replace(tmp, path)   # atomic publish
+    data = flax.serialization.to_bytes(payload)
+    durable.atomic_write_bytes(path, data)
+    if manifest:
+        durable.write_manifest(path, step, data, qualified=qualified)
     return path
 
 
 def latest_checkpoint(ckpt_dir: str, prefix: str = "ckpt") -> Optional[str]:
-    if not os.path.isdir(ckpt_dir):
-        return None
-    steps = []
-    for f in os.listdir(ckpt_dir):
-        if f.startswith(prefix + "-") and f.endswith(".msgpack"):
-            try:
-                steps.append((int(f[len(prefix) + 1:-len(".msgpack")]), f))
-            except ValueError:
-                continue
-    if not steps:
-        return None
-    return os.path.join(ckpt_dir, max(steps)[1])
+    """Newest checkpoint file by step (no verification — use
+    ``durable.latest_verified_checkpoint`` on resume paths). Stale
+    ``*.tmp`` remnants from a crashed writer are garbage-collected on
+    the way through the scan."""
+    entries = durable.scan_checkpoints(ckpt_dir, prefix)
+    return entries[0][1] if entries else None
 
 
-def _merge_missing(template, loaded, path="", defaulted=None, dropped=None):
+# ---------------------------------------------------------------------------
+# shared raw reader (one decode per file for restore + load_extra)
+
+_READ_CACHE: Dict[str, Tuple[Tuple[int, int], Any]] = {}
+_READ_CACHE_MAX = 4
+_READ_CACHE_LOCK = threading.Lock()
+
+
+def read_payload(path: str, use_cache: bool = True) -> Any:
+    """The raw msgpack payload of ``path`` ({"step", "state", "extra"?}).
+
+    ``restore_checkpoint`` and ``load_extra`` both need the same file on
+    every resume; a tiny cache keyed on (mtime_ns, size) makes that one
+    open + one decode instead of two. Callers must not mutate the
+    returned tree (restore shallow-copies before popping keys)."""
+    apath = os.path.abspath(path)
+    st = os.stat(apath)
+    key = (st.st_mtime_ns, st.st_size)
+    if use_cache:
+        with _READ_CACHE_LOCK:
+            hit = _READ_CACHE.get(apath)
+            if hit is not None and hit[0] == key:
+                return hit[1]
+    with open(apath, "rb") as f:
+        raw = flax.serialization.msgpack_restore(f.read())
+    if use_cache:
+        with _READ_CACHE_LOCK:
+            if len(_READ_CACHE) >= _READ_CACHE_MAX and apath not in _READ_CACHE:
+                _READ_CACHE.pop(next(iter(_READ_CACHE)))
+            _READ_CACHE[apath] = (key, raw)
+    return raw
+
+
+def _merge_missing(template, loaded, path="", defaulted=None, dropped=None,
+                   counts=None):
     """Overlay ``loaded`` on ``template``, keeping template defaults for keys
     the checkpoint predates (e.g. a DistTrainState field added after the
     checkpoint was saved — strict flax restore would raise 'Missing field').
@@ -73,14 +132,18 @@ def _merge_missing(template, loaded, path="", defaulted=None, dropped=None):
     A ``None`` in the checkpoint never replaces a non-``None`` template leaf
     (e.g. a momentum buffer the saved run had disabled) — the template's
     freshly-initialised value wins. ``defaulted``/``dropped`` collect the
-    key paths that kept template values / were ignored, for diagnostics."""
+    key paths that kept template values / were ignored, for diagnostics;
+    ``counts`` (keys ``defaulted``/``dropped``) accumulates the same in
+    *leaves*, the unit the escalation threshold is measured in."""
     if isinstance(template, dict):
         if not isinstance(loaded, dict):
             return loaded
-        if dropped is not None:
-            for k in loaded:
-                if k not in template:
+        for k in loaded:
+            if k not in template:
+                if dropped is not None:
                     dropped.append(f"{path}{k}")
+                if counts is not None:
+                    counts["dropped"] += _num_leaves(loaded[k])
         out = {}
         for k, v in template.items():
             if k in loaded:
@@ -88,16 +151,67 @@ def _merge_missing(template, loaded, path="", defaulted=None, dropped=None):
                 if lv is None and v is not None:
                     if defaulted is not None:
                         defaulted.append(f"{path}{k}")
+                    if counts is not None:
+                        counts["defaulted"] += _num_leaves(v)
                     out[k] = v
                 else:
                     out[k] = _merge_missing(v, lv, f"{path}{k}/",
-                                            defaulted, dropped)
+                                            defaulted, dropped, counts)
             else:
                 if defaulted is not None:
                     defaulted.append(f"{path}{k}")
+                if counts is not None:
+                    counts["defaulted"] += _num_leaves(v)
                 out[k] = v
         return out
     return loaded
+
+
+def _num_leaves(tree: Any) -> int:
+    """Leaves under a state-dict subtree (a dict counts its values
+    recursively; anything else, None included, is one leaf)."""
+    if isinstance(tree, dict):
+        return sum(_num_leaves(v) for v in tree.values())
+    return 1
+
+
+def apply_template(raw: Any, state_template: Any, path: str = "<payload>",
+                   force: bool = False) -> Tuple[Any, int]:
+    """Merge an already-decoded checkpoint payload into the template's
+    pytree structure; returns ``(state, step)``.
+
+    This is the template half of :func:`restore_checkpoint`, split out
+    so ``durable.verified_restore`` can verify/decode candidates itself
+    and share :func:`read_payload`'s cache. When more than
+    ``MERGE_ESCALATION_FRAC`` of the leaves were defaulted or dropped,
+    the checkpoint is almost certainly for a different model/config and
+    this raises ``ValueError`` (``force=True`` — the ``--ckpt-force``
+    flag — downgrades it to the warning)."""
+    raw = dict(raw)              # never mutate read_payload's cached tree
+    raw.pop("extra", None)       # side payload (load_extra), not train state
+    wrapped = {"step": 0, "state": jax.device_get(state_template)}
+    wrapped_sd = flax.serialization.to_state_dict(wrapped)
+    defaulted, dropped = [], []
+    counts = {"defaulted": 0, "dropped": 0}
+    merged = _merge_missing(wrapped_sd, raw, defaulted=defaulted,
+                            dropped=dropped, counts=counts)
+    if defaulted or dropped:
+        total = _num_leaves(wrapped_sd) + counts["dropped"]
+        frac = (counts["defaulted"] + counts["dropped"]) / max(1, total)
+        msg = (f"checkpoint {path} does not fully match the current "
+               f"state: {len(defaulted)} field(s) kept fresh template "
+               f"values {defaulted[:8]}; {len(dropped)} checkpoint "
+               f"field(s) ignored {dropped[:8]} "
+               f"({frac:.0%} of leaves mismatched)")
+        if frac > MERGE_ESCALATION_FRAC and not force:
+            raise ValueError(
+                msg + f" — above the {MERGE_ESCALATION_FRAC:.0%} "
+                "threshold, this checkpoint is almost certainly for a "
+                "different --model/config; pass --ckpt-force to restore "
+                "anyway")
+        _log.warning("%s", msg)
+    payload = flax.serialization.from_state_dict(wrapped, merged)
+    return payload["state"], int(payload["step"])
 
 
 def load_encoder_params(ckpt_dir_or_file: str, params: Any,
@@ -119,11 +233,10 @@ def load_encoder_params(ckpt_dir_or_file: str, params: Any,
     """
     path = ckpt_dir_or_file
     if os.path.isdir(path):
-        path = latest_checkpoint(path, prefix)
+        path = durable.latest_verified_checkpoint(path, prefix)
         if path is None:
             raise FileNotFoundError(f"no checkpoints in {ckpt_dir_or_file}")
-    with open(path, "rb") as f:
-        raw = flax.serialization.msgpack_restore(f.read())
+    raw = read_payload(path)
     loaded = raw.get("state", raw)
     loaded = loaded.get("params", loaded)
     if subtree not in loaded:
@@ -155,15 +268,15 @@ def load_encoder_params(ckpt_dir_or_file: str, params: Any,
 def load_extra(ckpt_dir_or_file: str, prefix: str = "ckpt"
                ) -> Optional[dict]:
     """The ``extra`` side payload of a checkpoint (None when the file
-    predates it or was saved without one)."""
+    predates it or was saved without one). Shares :func:`read_payload`'s
+    cache with ``restore_checkpoint``, so a resume that reads both pays
+    one decode."""
     path = ckpt_dir_or_file
     if os.path.isdir(path):
-        path = latest_checkpoint(path, prefix)
+        path = durable.latest_verified_checkpoint(path, prefix)
         if path is None:
             return None
-    with open(path, "rb") as f:
-        raw = flax.serialization.msgpack_restore(f.read())
-    extra = raw.get("extra")
+    extra = read_payload(path).get("extra")
     if extra is None:
         return None
     if isinstance(extra, bytes):
@@ -172,32 +285,31 @@ def load_extra(ckpt_dir_or_file: str, prefix: str = "ckpt"
 
 
 def restore_checkpoint(ckpt_dir_or_file: str, state_template: Any,
-                       prefix: str = "ckpt") -> Tuple[Any, int]:
+                       prefix: str = "ckpt", verify: bool = True,
+                       bus=None, journal=None, step: int = 0,
+                       force: bool = False) -> Tuple[Any, int]:
     """Restore into the template's pytree structure; returns (state, step).
 
     Fields present in the template but absent from the file keep the
     template's (freshly initialised) values, so checkpoints saved before a
-    state field existed still resume."""
+    state field existed still resume; a mismatch beyond
+    ``MERGE_ESCALATION_FRAC`` of leaves raises (``force`` overrides).
+
+    With ``verify=True`` (the default) candidates are checked against
+    their manifests and walked newest -> oldest past corrupt files,
+    journalling ``ckpt_verify_failed``/``ckpt_restore`` onto ``journal``
+    (a HealthJournal) or ``bus`` when given — see
+    ``durable.verified_restore`` for the full contract. ``verify=False``
+    restores exactly the named file with no fallback."""
+    if verify:
+        state, ckpt_step, _, _, _ = durable.verified_restore(
+            ckpt_dir_or_file, state_template, prefix=prefix, bus=bus,
+            journal=journal, step=step, force=force)
+        return state, ckpt_step
     path = ckpt_dir_or_file
     if os.path.isdir(path):
         path = latest_checkpoint(path, prefix)
         if path is None:
             raise FileNotFoundError(f"no checkpoints in {ckpt_dir_or_file}")
-    with open(path, "rb") as f:
-        raw = flax.serialization.msgpack_restore(f.read())
-    raw.pop("extra", None)   # side payload (load_extra), not train state
-    wrapped = {"step": 0, "state": jax.device_get(state_template)}
-    defaulted, dropped = [], []
-    merged = _merge_missing(flax.serialization.to_state_dict(wrapped), raw,
-                            defaulted=defaulted, dropped=dropped)
-    if defaulted or dropped:
-        import logging
-        logging.getLogger("oktopk_tpu").warning(
-            "checkpoint %s does not fully match the current state: "
-            "%d field(s) kept fresh template values %s; %d checkpoint "
-            "field(s) ignored %s", path, len(defaulted), defaulted[:8],
-            len(dropped), dropped[:8])
-    payload = flax.serialization.from_state_dict(wrapped, merged)
-    return payload["state"], int(payload["step"])
-
-
+    return apply_template(read_payload(path), state_template, path=path,
+                          force=force)

@@ -1,0 +1,585 @@
+"""Durable state plane: verified, async, self-healing checkpoints.
+
+``train/checkpoint.py`` serialises the whole DistTrainState — params,
+optimizer moments, and crucially the error-feedback state (residuals,
+thresholds, boundaries) whose loss the reference never notices
+(SURVEY.md §5.4). Every recovery path in the repo bottoms out there:
+the supervisor's divergence restore (``resilience/supervisor.py``),
+remesh carry-over, and preemption park/requeue. A checkpoint that lies
+— truncated by a crashed writer, bit-rotted on disk, half-replaced by
+a torn write — is therefore a *silent accuracy regression*, not just a
+crash. This module makes the storage leg of the self-healing loop as
+trustworthy as the in-step leg:
+
+- **Manifests** (:func:`write_manifest`): every checkpoint gets a
+  ``ckpt-<step>.manifest.json`` sidecar carrying a digest of the
+  msgpack bytes, the payload size, the environment fingerprint from
+  ``environment_header()`` (schema/jax/device), and a ``qualified`` bit
+  mirroring the supervisor's good-vs-mid-incident distinction.
+- **Verification** (:func:`verify_checkpoint`,
+  :func:`verified_restore`): restore walks candidates newest → oldest,
+  skipping digest/size mismatches and torn writes, journalling a
+  ``ckpt_verify_failed`` event per rejected file and a ``ckpt_restore``
+  for the one that loaded — a restore that fell back two checkpoints is
+  visible on the incident timeline. Manifest-less (legacy) checkpoints
+  are accepted with a journalled warning, never rejected.
+- **Async saving** (:class:`AsyncCheckpointer`): the caller thread only
+  pays ``jax.device_get``; serialize + fsync'd atomic write + post-write
+  verify run on a background thread with bounded queue depth,
+  barrier-on-exit (:meth:`AsyncCheckpointer.drain` — the preemption
+  epilogue and ``main_trainer.py`` drain it), and write-failure
+  escalation to the supervisor instead of a swallowed exception.
+- **Retention** (:func:`apply_retention`): keep-last-N plus an
+  always-pin of the newest *qualified* checkpoint, so the supervisor's
+  divergence restore never loses its target to garbage collection.
+
+Offline, ``scripts/ckpt_fsck.py`` runs the same verification over a
+checkpoint directory as a pre-resume CI/cron gate.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import logging
+import os
+import queue
+import threading
+import time
+import zlib
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+MANIFEST_VERSION = 1
+MANIFEST_SUFFIX = ".manifest.json"
+
+_log = logging.getLogger("oktopk_tpu")
+
+
+# ---------------------------------------------------------------------------
+# digests
+
+def _crc32(data: bytes) -> str:
+    return f"{zlib.crc32(data) & 0xFFFFFFFF:08x}"
+
+
+_DIGESTS: Dict[str, Callable[[bytes], str]] = {"crc32": _crc32}
+try:  # optional, never installed here — gate, don't require
+    import xxhash as _xxhash
+
+    _DIGESTS["xxh64"] = lambda data: _xxhash.xxh64(data).hexdigest()
+except Exception:  # pragma: no cover - container has no xxhash
+    pass
+
+DEFAULT_DIGEST = "crc32"
+
+
+def compute_digest(data: bytes, algo: str = DEFAULT_DIGEST) -> str:
+    """``"<algo>:<hex>"`` of ``data`` (crc32 always available; xxh64 when
+    the library exists — the manifest records which, so a file written
+    with one can verify on a host that has both)."""
+    if algo not in _DIGESTS:
+        raise ValueError(f"unknown digest algo {algo!r}; "
+                         f"one of {sorted(_DIGESTS)}")
+    return f"{algo}:{_DIGESTS[algo](data)}"
+
+
+def _digest_matches(data: bytes, recorded: str) -> Optional[bool]:
+    """True/False when the recorded digest's algo is computable here,
+    None when it is not (treated as unverifiable, not corrupt)."""
+    algo = recorded.split(":", 1)[0]
+    if algo not in _DIGESTS:
+        return None
+    return compute_digest(data, algo) == recorded
+
+
+# ---------------------------------------------------------------------------
+# atomic, torn-write-safe file publication
+
+def fsync_dir(dirpath: str) -> None:
+    """fsync a directory so a just-published rename survives power loss
+    (best-effort: not every filesystem exposes a dir fd)."""
+    try:
+        fd = os.open(dirpath, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
+def atomic_write_bytes(path: str, data: bytes) -> None:
+    """tmp-file -> flush -> fsync -> ``os.replace`` -> dir fsync: a
+    reader never sees a partial file, and a crash between any two steps
+    leaves either the old file or a ``*.tmp`` remnant (which the
+    checkpoint scan garbage-collects), never a torn publish."""
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        f.write(data)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+    fsync_dir(os.path.dirname(os.path.abspath(path)))
+
+
+def clean_stale_tmp(ckpt_dir: str, max_age_s: float = 3600.0) -> List[str]:
+    """Remove ``*.tmp`` remnants left by a crashed writer. Only files
+    older than ``max_age_s`` go — an in-flight :class:`AsyncCheckpointer`
+    write must not have its tmp file deleted from under it."""
+    removed: List[str] = []
+    if not os.path.isdir(ckpt_dir):
+        return removed
+    now = time.time()
+    for name in os.listdir(ckpt_dir):
+        if not name.endswith(".tmp"):
+            continue
+        path = os.path.join(ckpt_dir, name)
+        try:
+            if now - os.path.getmtime(path) >= max_age_s:
+                os.remove(path)
+                removed.append(path)
+        except OSError:
+            continue
+    return removed
+
+
+# ---------------------------------------------------------------------------
+# manifests
+
+def manifest_path(ckpt_path: str) -> str:
+    """``ckpt-<step>.msgpack`` -> ``ckpt-<step>.manifest.json``."""
+    base = ckpt_path
+    if base.endswith(".msgpack"):
+        base = base[: -len(".msgpack")]
+    return base + MANIFEST_SUFFIX
+
+
+def write_manifest(ckpt_path: str, step: int, data: bytes,
+                   qualified: bool = True,
+                   digest_algo: str = DEFAULT_DIGEST) -> Dict[str, Any]:
+    """Publish the sidecar manifest for an already-published checkpoint
+    file. Written atomically AFTER the data file: a crash in between
+    leaves a fully-written but manifest-less checkpoint, which the
+    verifying path accepts as legacy (with a journalled warning) rather
+    than rejecting a good file."""
+    from oktopk_tpu.autotune.journal import environment_header
+
+    man = {
+        "manifest_version": MANIFEST_VERSION,
+        "file": os.path.basename(ckpt_path),
+        "step": int(step),
+        "bytes": len(data),
+        "digest": compute_digest(data, digest_algo),
+        "qualified": bool(qualified),
+        "environment": environment_header(),
+        "created": time.time(),
+    }
+    atomic_write_bytes(manifest_path(ckpt_path),
+                       (json.dumps(man, sort_keys=True) + "\n").encode())
+    return man
+
+
+def read_manifest(ckpt_path: str) -> Optional[Dict[str, Any]]:
+    """The parsed sidecar manifest, or None when absent/unparseable."""
+    try:
+        with open(manifest_path(ckpt_path)) as f:
+            man = json.load(f)
+        return man if isinstance(man, dict) else None
+    except (OSError, ValueError):
+        return None
+
+
+# ---------------------------------------------------------------------------
+# verification
+
+@dataclasses.dataclass
+class VerifyResult:
+    """Verdict for one checkpoint file."""
+
+    path: str
+    ok: bool
+    reason: str = "ok"           # why it failed (or "ok" / "no_manifest")
+    legacy: bool = False         # no manifest: accepted, but unverifiable
+    qualified: bool = True       # manifest's qualified bit (True if legacy)
+    manifest: Optional[Dict[str, Any]] = None
+    env_mismatch: bool = False   # saved under a different jax/schema
+
+
+def verify_checkpoint(ckpt_path: str, deep: bool = False) -> VerifyResult:
+    """Check one checkpoint file against its manifest.
+
+    Failure modes, in check order: missing/empty file; manifest present
+    but size mismatched (truncation / torn write); digest mismatched
+    (bit rot / flipped bytes). A missing manifest is NOT a failure — the
+    file predates the durable plane — but flags ``legacy`` so callers
+    can journal the warning. ``deep=True`` additionally decodes the
+    msgpack container (fsck's thorough mode; legacy files get no other
+    check)."""
+    if not os.path.isfile(ckpt_path):
+        return VerifyResult(ckpt_path, False, reason="missing_file")
+    try:
+        with open(ckpt_path, "rb") as f:
+            data = f.read()
+    except OSError as e:
+        return VerifyResult(ckpt_path, False, reason=f"unreadable: {e}")
+    if not data:
+        return VerifyResult(ckpt_path, False, reason="empty_file")
+
+    man = read_manifest(ckpt_path)
+    if man is None:
+        res = VerifyResult(ckpt_path, True, reason="no_manifest",
+                           legacy=True)
+    else:
+        if int(man.get("bytes", -1)) != len(data):
+            return VerifyResult(
+                ckpt_path, False, manifest=man,
+                qualified=bool(man.get("qualified", True)),
+                reason=f"size_mismatch: manifest {man.get('bytes')} B "
+                       f"vs file {len(data)} B")
+        match = _digest_matches(data, str(man.get("digest", "")))
+        if match is False:
+            return VerifyResult(
+                ckpt_path, False, manifest=man,
+                qualified=bool(man.get("qualified", True)),
+                reason="digest_mismatch")
+        env = man.get("environment") or {}
+        from oktopk_tpu.obs.events import SCHEMA_VERSION
+        env_mismatch = (env.get("schema_version") is not None
+                        and int(env["schema_version"]) != SCHEMA_VERSION)
+        res = VerifyResult(ckpt_path, True, manifest=man,
+                           qualified=bool(man.get("qualified", True)),
+                           reason=("digest_unverifiable"
+                                   if match is None else "ok"),
+                           env_mismatch=env_mismatch)
+    if deep:
+        try:
+            import flax.serialization
+            flax.serialization.msgpack_restore(data)
+        except Exception as e:
+            return VerifyResult(ckpt_path, False, legacy=res.legacy,
+                                manifest=res.manifest,
+                                qualified=res.qualified,
+                                reason=f"decode_error: {type(e).__name__}")
+    return res
+
+
+def scan_checkpoints(ckpt_dir: str, prefix: str = "ckpt",
+                     clean_tmp: bool = True,
+                     stale_tmp_age_s: float = 3600.0
+                     ) -> List[Tuple[int, str]]:
+    """``[(step, path), ...]`` newest first; optionally garbage-collects
+    stale ``*.tmp`` remnants on the way through."""
+    if not os.path.isdir(ckpt_dir):
+        return []
+    if clean_tmp:
+        clean_stale_tmp(ckpt_dir, max_age_s=stale_tmp_age_s)
+    out = []
+    for name in os.listdir(ckpt_dir):
+        if name.startswith(prefix + "-") and name.endswith(".msgpack"):
+            try:
+                out.append((int(name[len(prefix) + 1:-len(".msgpack")]),
+                            os.path.join(ckpt_dir, name)))
+            except ValueError:
+                continue
+    return sorted(out, reverse=True)
+
+
+def candidate_paths(ckpt_dir_or_file: str, prefix: str = "ckpt"
+                    ) -> List[str]:
+    """Restore candidates newest -> oldest. A directory yields its whole
+    scan; a file yields that file first, then any strictly-older
+    siblings with the same prefix (the fallback ladder for a supervisor
+    restore whose registered target turns out corrupt)."""
+    if os.path.isdir(ckpt_dir_or_file):
+        return [p for _, p in scan_checkpoints(ckpt_dir_or_file, prefix)]
+    d, name = os.path.split(ckpt_dir_or_file)
+    step = None
+    if name.startswith(prefix + "-") and name.endswith(".msgpack"):
+        try:
+            step = int(name[len(prefix) + 1:-len(".msgpack")])
+        except ValueError:
+            step = None
+    if step is None:
+        return [ckpt_dir_or_file]
+    older = [p for s, p in scan_checkpoints(d, prefix) if s < step]
+    return [ckpt_dir_or_file] + older
+
+
+def _emit(journal, bus, event: str, **fields) -> None:
+    """One durable-plane event onto whichever sink the caller has: the
+    health journal (which forwards to the bus itself) wins over a bare
+    bus so the event is never double-delivered."""
+    if journal is not None:
+        journal.record(event, **fields)
+    elif bus is not None:
+        bus.emit(event, **fields)
+
+
+def latest_verified_checkpoint(ckpt_dir: str, prefix: str = "ckpt",
+                               bus=None, journal=None,
+                               step: int = 0) -> Optional[str]:
+    """Newest checkpoint that passes verification (legacy accepted),
+    journalling a ``ckpt_verify_failed`` for each newer file skipped —
+    the verifying replacement for ``checkpoint.latest_checkpoint`` on
+    every resume path."""
+    for path in candidate_paths(ckpt_dir, prefix):
+        v = verify_checkpoint(path)
+        if v.ok:
+            return path
+        _emit(journal, bus, "ckpt_verify_failed", step=int(step),
+              path=path, reason=v.reason)
+        _log.warning("checkpoint %s failed verification (%s); skipping",
+                     path, v.reason)
+    return None
+
+
+def verified_restore(ckpt_dir_or_file: str, state_template: Any,
+                     prefix: str = "ckpt", bus=None, journal=None,
+                     step: int = 0, force: bool = False
+                     ) -> Tuple[Any, int, str, int, bool]:
+    """Restore from the newest checkpoint that verifies AND decodes,
+    walking candidates newest -> oldest.
+
+    Returns ``(state, ckpt_step, path, fallback_depth, legacy)`` where
+    ``fallback_depth`` counts the newer checkpoints that had to be
+    skipped (0 = the intended target loaded). Journals one
+    ``ckpt_verify_failed`` per rejected file (digest/size mismatch,
+    torn write, undecodable legacy) and one ``ckpt_restore`` for the
+    winner, so the incident timeline shows exactly how far back the run
+    had to reach. Raises ``FileNotFoundError`` when no candidate is
+    restorable; a template/checkpoint structure mismatch beyond the
+    merge threshold raises ``ValueError`` *without* falling back — a
+    wrong ``--model`` must fail loudly, not restore an older wrong
+    checkpoint (``force=True`` is the escape hatch)."""
+    from oktopk_tpu.train import checkpoint as ckpt
+
+    depth = 0
+    candidates = candidate_paths(ckpt_dir_or_file, prefix)
+    for path in candidates:
+        v = verify_checkpoint(path)
+        if not v.ok:
+            _emit(journal, bus, "ckpt_verify_failed", step=int(step),
+                  path=path, reason=v.reason)
+            _log.warning("checkpoint %s failed verification (%s); "
+                         "falling back", path, v.reason)
+            depth += 1
+            continue
+        try:
+            raw = ckpt.read_payload(path)
+        except Exception as e:
+            # digest-clean files cannot hit this; an unverifiable legacy
+            # file (truncated before manifests existed) can
+            _emit(journal, bus, "ckpt_verify_failed", step=int(step),
+                  path=path, reason=f"decode_error: {type(e).__name__}")
+            _log.warning("checkpoint %s undecodable (%r); falling back",
+                         path, e)
+            depth += 1
+            continue
+        if v.legacy:
+            _log.warning("checkpoint %s has no manifest (predates the "
+                         "durable state plane): restoring unverified",
+                         path)
+        if v.env_mismatch:
+            _log.warning("checkpoint %s was saved under a different "
+                         "journal schema: %s", path,
+                         (v.manifest or {}).get("environment"))
+        state, ckpt_step = ckpt.apply_template(raw, state_template,
+                                               path=path, force=force)
+        _emit(journal, bus, "ckpt_restore", step=int(step), path=path,
+              ckpt_step=int(ckpt_step), fallback_depth=depth,
+              legacy=bool(v.legacy))
+        return state, int(ckpt_step), path, depth, bool(v.legacy)
+    raise FileNotFoundError(
+        f"no restorable checkpoint in {ckpt_dir_or_file!r} "
+        f"({len(candidates)} candidate(s), all failed verification)")
+
+
+# ---------------------------------------------------------------------------
+# retention
+
+def apply_retention(ckpt_dir: str, prefix: str = "ckpt",
+                    keep_last: int = 0, pin_qualified: bool = True
+                    ) -> List[str]:
+    """Delete checkpoints (and their manifests) beyond the newest
+    ``keep_last``, always keeping the newest *qualified* one so the
+    supervisor's divergence restore never loses its target
+    (``keep_last=0`` disables retention entirely). Returns the deleted
+    paths."""
+    if keep_last <= 0:
+        return []
+    entries = scan_checkpoints(ckpt_dir, prefix, clean_tmp=False)
+    keep = {p for _, p in entries[:keep_last]}
+    if pin_qualified:
+        for _, p in entries:
+            man = read_manifest(p)
+            if man is None or man.get("qualified", True):
+                keep.add(p)   # legacy files count as qualified: never
+                break         # garbage-collect the only restore target
+    deleted = []
+    for _, p in entries:
+        if p in keep:
+            continue
+        for f in (p, manifest_path(p)):
+            try:
+                os.remove(f)
+            except OSError:
+                continue
+        deleted.append(p)
+    return deleted
+
+
+# ---------------------------------------------------------------------------
+# async checkpointing
+
+class AsyncCheckpointer:
+    """Non-blocking checkpoint writer with a bounded queue.
+
+    ``save()`` snapshots the state with ``jax.device_get`` on the caller
+    thread (the only part that must see a consistent train state) and
+    enqueues it; a daemon worker serialises, writes atomically
+    (fsync + ``os.replace`` via ``checkpoint.save_checkpoint``),
+    re-reads and verifies the published file against its manifest, and
+    applies the retention policy. The queue depth bounds host memory:
+    when ``queue_depth`` snapshots are already in flight, ``save()``
+    blocks — training throttles rather than OOMing on a slow disk.
+
+    Failures are escalated, never swallowed: a write or post-write
+    verify error journals ``ckpt_verify_failed`` (reason
+    ``write_failed: ...``), increments ``write_failures`` and invokes
+    ``on_failure(step, path, exc)`` — the trainer wires that to the
+    supervisor (``Trainer.note_ckpt_failure``).
+
+    **Barrier-on-exit:** callers must :meth:`drain` (or :meth:`close`)
+    before exiting — the preemption epilogue and ``main_trainer.py`` do
+    — so an async save in flight at preemption time is published whole,
+    never torn.
+    """
+
+    def __init__(self, ckpt_dir: str, prefix: str = "ckpt",
+                 queue_depth: int = 2, keep_last: int = 0,
+                 pin_qualified: bool = True, bus=None, journal=None,
+                 on_failure: Optional[Callable[[int, str, BaseException],
+                                               None]] = None,
+                 verify: bool = True):
+        self.ckpt_dir = ckpt_dir
+        self.prefix = prefix
+        self.keep_last = int(keep_last)
+        self.pin_qualified = bool(pin_qualified)
+        self.bus = bus
+        self.journal = journal
+        self.on_failure = on_failure
+        self.verify = bool(verify)
+        self.saves = 0              # completed, verified saves
+        self.verify_failures = 0    # post-write verification failures
+        self.write_failures = 0     # any failed save (verify included)
+        self.last_path: Optional[str] = None
+        self._q: "queue.Queue" = queue.Queue(maxsize=max(1, queue_depth))
+        self._pending = 0
+        self._cond = threading.Condition()
+        self._closed = False
+        self._thread = threading.Thread(
+            target=self._worker, name="oktopk-async-ckpt", daemon=True)
+        self._thread.start()
+
+    # ---- producer side ------------------------------------------------
+
+    def path_for(self, step: int) -> str:
+        return os.path.join(self.ckpt_dir,
+                            f"{self.prefix}-{int(step)}.msgpack")
+
+    def save(self, state: Any, step: int, extra: Optional[dict] = None,
+             qualified: bool = True) -> str:
+        """Snapshot ``state`` to host and enqueue the write; returns the
+        path the checkpoint WILL occupy once published (register it with
+        ``Trainer.note_checkpoint`` — a restore that races the write
+        self-heals by falling back to an older verified file)."""
+        if self._closed:
+            raise RuntimeError("AsyncCheckpointer is closed")
+        import jax
+
+        host = jax.device_get(state)
+        with self._cond:
+            self._pending += 1
+        self._q.put((host, int(step), extra, bool(qualified)))
+        return self.path_for(step)
+
+    def drain(self, timeout: Optional[float] = None) -> bool:
+        """Block until every enqueued save has been written and verified
+        (the exit barrier). Returns False on timeout."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._cond:
+            while self._pending > 0:
+                remaining = (None if deadline is None
+                             else deadline - time.monotonic())
+                if remaining is not None and remaining <= 0:
+                    return False
+                self._cond.wait(remaining)
+        return True
+
+    def close(self, timeout: Optional[float] = None) -> bool:
+        """Drain, then stop the worker thread."""
+        drained = self.drain(timeout)
+        if not self._closed:
+            self._closed = True
+            self._q.put(None)
+            self._thread.join(timeout)
+        return drained
+
+    def __enter__(self) -> "AsyncCheckpointer":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    # ---- worker side --------------------------------------------------
+
+    def _worker(self) -> None:
+        while True:
+            item = self._q.get()
+            if item is None:
+                return
+            host, step, extra, qualified = item
+            path = self.path_for(step)
+            t0 = time.monotonic()
+            try:
+                from oktopk_tpu.train.checkpoint import save_checkpoint
+
+                path = save_checkpoint(self.ckpt_dir, host, step,
+                                       prefix=self.prefix, extra=extra,
+                                       qualified=qualified)
+                if self.verify:
+                    v = verify_checkpoint(path)
+                    if not v.ok:
+                        self.verify_failures += 1
+                        raise RuntimeError(
+                            f"post-write verification failed: {v.reason}")
+                if self.keep_last:
+                    apply_retention(self.ckpt_dir, self.prefix,
+                                    self.keep_last, self.pin_qualified)
+                self.saves += 1
+                self.last_path = path
+                man = read_manifest(path) or {}
+                _emit(self.journal, self.bus, "ckpt_saved",
+                      step=int(step), path=path,
+                      bytes=int(man.get("bytes", 0)),
+                      digest=str(man.get("digest", "")),
+                      qualified=bool(qualified), source="async",
+                      duration_ms=(time.monotonic() - t0) * 1e3)
+            except Exception as e:
+                self.write_failures += 1
+                _emit(self.journal, self.bus, "ckpt_verify_failed",
+                      step=int(step), path=path,
+                      reason=f"write_failed: {type(e).__name__}: {e}")
+                _log.error("async checkpoint save @ step %d failed: %r",
+                           step, e)
+                if self.on_failure is not None:
+                    try:
+                        self.on_failure(step, path, e)
+                    except Exception:  # escalation must not kill the
+                        pass           # writer thread
+            finally:
+                with self._cond:
+                    self._pending -= 1
+                    self._cond.notify_all()

@@ -138,6 +138,20 @@ def parse_args(argv=None):
     p.add_argument("--ckpt-dir", default=None)
     p.add_argument("--ckpt-every", type=int, default=0,
                    help="checkpoint every N iterations (0 = off)")
+    p.add_argument("--ckpt-async", action="store_true",
+                   help="write checkpoints on a background thread "
+                        "(durable.AsyncCheckpointer): the step loop only "
+                        "pays jax.device_get; serialize+fsync+verify run "
+                        "off-thread with bounded queue depth and a drain "
+                        "barrier on exit")
+    p.add_argument("--ckpt-keep", type=int, default=0,
+                   help="retention: keep the newest N checkpoints plus "
+                        "the newest qualified one (0 = keep everything)")
+    p.add_argument("--ckpt-force", action="store_true",
+                   help="restore a checkpoint even when most of its "
+                        "leaves mismatch the model (normally that raises "
+                        "— it almost always means the wrong --model for "
+                        "this checkpoint)")
     p.add_argument("--resume", default=None,
                    help="checkpoint directory to resume from")
     p.add_argument("--handle-preemption", action="store_true",
@@ -240,8 +254,12 @@ def main(argv=None):
     start_iter = 0
     if args.resume:
         from oktopk_tpu.train.checkpoint import restore_checkpoint
+        # verifying resume: digest-checked against the sidecar manifest,
+        # walking newest -> oldest past corrupt files, journalled on the
+        # run's bus (ckpt_verify_failed / ckpt_restore)
         trainer.state, start_iter = restore_checkpoint(
-            args.resume, trainer.state)
+            args.resume, trainer.state, bus=trainer.bus,
+            force=args.ckpt_force)
         # re-arm the escalation ladder: strike counters + any active
         # per-bucket dense fallbacks resume with the train state
         trainer.restore_supervisor(args.resume)
@@ -270,6 +288,15 @@ def main(argv=None):
     from oktopk_tpu.utils.profiling import (MetricWriter, PhaseTimers,
                                             TraceWindow, device_memory_stats)
     rundir = os.path.join(args.logdir, slug)
+    checkpointer = None
+    if is_rank0 and args.ckpt_dir and args.ckpt_every and args.ckpt_async:
+        from oktopk_tpu.train.durable import AsyncCheckpointer
+        journal = (trainer.supervisor.journal
+                   if trainer.supervisor is not None else None)
+        checkpointer = AsyncCheckpointer(
+            args.ckpt_dir, keep_last=args.ckpt_keep,
+            journal=journal, bus=trainer.bus,
+            on_failure=trainer.note_ckpt_failure)
     writer = MetricWriter(rundir) if is_rank0 else None
     timers = PhaseTimers(every=args.log_every) if args.phase_timers else None
     trace = (TraceWindow(os.path.join(rundir, "trace"), args.trace_at,
@@ -311,15 +338,31 @@ def main(argv=None):
                 mem.get("bytes_in_use", 0) / 2**20)
             if (is_rank0 and args.ckpt_dir and args.ckpt_every
                     and done % args.ckpt_every == 0):
-                from oktopk_tpu.train.checkpoint import save_checkpoint
-                path = save_checkpoint(args.ckpt_dir, trainer.state, done,
-                                       extra=trainer.supervisor_extra())
+                if checkpointer is not None:
+                    path = checkpointer.save(
+                        trainer.state, done,
+                        extra=trainer.supervisor_extra(),
+                        qualified=trainer.checkpoint_qualified)
+                else:
+                    from oktopk_tpu.train.checkpoint import save_checkpoint
+                    path = save_checkpoint(
+                        args.ckpt_dir, trainer.state, done,
+                        extra=trainer.supervisor_extra(),
+                        qualified=trainer.checkpoint_qualified)
+                    if args.ckpt_keep:
+                        from oktopk_tpu.train.durable import apply_retention
+                        apply_retention(args.ckpt_dir,
+                                        keep_last=args.ckpt_keep)
                 trainer.note_checkpoint(path, done)
     finally:
         if writer is not None:
             writer.close()
         if trace is not None:
             trace.close()
+        if checkpointer is not None and preempt is None:
+            # with a preemption handler the epilogue drains instead (an
+            # async save in flight must publish whole before exit)
+            checkpointer.close(timeout=300.0)
 
     if preempt is not None:
         # park-state/requeue (or clear on success) — reference
@@ -327,7 +370,8 @@ def main(argv=None):
         from oktopk_tpu.train.preemption import epilogue
         return epilogue(trainer.state, done, preempt, logger,
                         rank=jax.process_index(), completed=done >= total,
-                        extra=trainer.supervisor_extra())
+                        extra=trainer.supervisor_extra(),
+                        checkpointer=checkpointer)
     return 0
 
 

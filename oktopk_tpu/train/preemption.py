@@ -126,11 +126,20 @@ def clear_interrupted_state(state_dir: str = DEFAULT_STATE_DIR,
 def epilogue(state, last_step: int, preempt: "PreemptionHandler", logger,
              rank: int = 0, completed: bool = False,
              state_dir: str = DEFAULT_STATE_DIR,
-             extra: Optional[dict] = None) -> int:
+             extra: Optional[dict] = None, checkpointer=None) -> int:
     """Shared driver exit path. If ``preempt`` fired before the run finished:
     park state (rank 0), requeue when requested, and return exit code 3.
     Otherwise clear any parked state for this job id (a completed run must
-    not be resumable into a stale snapshot) and return 0."""
+    not be resumable into a stale snapshot) and return 0.
+
+    ``checkpointer`` is the run's ``durable.AsyncCheckpointer`` (or
+    None): it is drained FIRST, whatever the exit reason — an async save
+    in flight when the preemption signal lands must publish whole, never
+    be left as a torn file for the requeued run to trip over."""
+    if checkpointer is not None:
+        if not checkpointer.drain(timeout=300.0):
+            logger.warning("async checkpointer failed to drain before "
+                           "exit; a queued save may be lost")
     if preempt is not None and preempt.should_stop() and not completed:
         if rank == 0:
             path = save_interrupted_state(state, last_step,

@@ -384,8 +384,26 @@ class Trainer:
             # forced_dense already updated by the supervisor
             self.step_fn = self._build_step()
         elif act.kind == "restore" and act.ckpt:
-            from oktopk_tpu.train.checkpoint import restore_checkpoint
-            self.state, _ = restore_checkpoint(act.ckpt, self.state)
+            # verified restore: walk newest -> oldest past corrupt
+            # files, journalling ckpt_verify_failed per rejected file
+            # BEFORE the restore record — so the journal names the
+            # checkpoint actually loaded, not the intended target
+            from oktopk_tpu.train.durable import verified_restore
+            journal = (self.supervisor.journal
+                       if self.supervisor is not None else None)
+            try:
+                self.state, ckpt_step, used, _, _ = verified_restore(
+                    act.ckpt, self.state, journal=journal, bus=self.bus,
+                    step=step)
+            except FileNotFoundError:
+                # every candidate corrupt: a restore cannot happen —
+                # journal the fact and fail loudly rather than keep
+                # training a diverged model
+                if journal is not None:
+                    journal.restore(step, None, -1)
+                raise
+            if journal is not None:
+                journal.restore(step, used, ckpt_step)
         elif act.kind == "remesh":
             self._execute_remesh(step, act.workers)
 
@@ -415,6 +433,25 @@ class Trainer:
         elif self.bus is not None:
             self.bus.emit("checkpoint", step=int(step), path=path,
                           qualified=True)
+
+    @property
+    def checkpoint_qualified(self) -> bool:
+        """Whether a checkpoint taken NOW would be a restore target (no
+        skips in flight) — recorded into the manifest's ``qualified``
+        bit so the retention policy and offline fsck see the same
+        good/mid-incident distinction the supervisor does."""
+        if self.supervisor is None:
+            return True
+        return self.supervisor.consecutive_skips == 0
+
+    def note_ckpt_failure(self, step: int, path: str, error) -> None:
+        """Escalate a failed (async) checkpoint write to the supervisor —
+        the ``on_failure`` hook for ``durable.AsyncCheckpointer``."""
+        if self.supervisor is not None:
+            self.supervisor.note_ckpt_write_failure(step, path, error)
+        elif self.bus is not None:
+            self.bus.emit("ckpt_verify_failed", step=int(step), path=path,
+                          reason=f"write_failed: {error}")
 
     def supervisor_extra(self):
         """The ``extra`` payload for ``checkpoint.save_checkpoint``: the

@@ -1,0 +1,88 @@
+#!/usr/bin/env python
+"""Offline checkpoint verifier — the pre-resume fsck for a ckpt dir.
+
+Usage:
+    python scripts/ckpt_fsck.py <ckpt-dir-or-file> [--prefix ckpt]
+        [--deep] [--clean-tmp]
+
+Walks every ``<prefix>-<step>.msgpack`` (newest first) and checks it
+against its sidecar manifest exactly as the in-run verifying restore
+does (``oktopk_tpu.train.durable.verify_checkpoint``): file present and
+non-empty, size matches, digest matches. ``--deep`` additionally decodes
+the msgpack container (slower; catches corruption inside a manifest-less
+legacy file). ``--clean-tmp`` sweeps stale ``*.tmp`` remnants older than
+an hour.
+
+Prints a per-file verdict and exits nonzero when any checkpoint is
+corrupt — usable as a CI/cron gate before pointing ``--resume`` at a
+directory. Legacy manifest-less files are reported but do NOT fail the
+gate (they predate the durable state plane and still restore); pass
+``--strict`` to fail on them too.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("path", help="checkpoint directory (or a single file)")
+    ap.add_argument("--prefix", default="ckpt")
+    ap.add_argument("--deep", action="store_true",
+                    help="also decode the msgpack container")
+    ap.add_argument("--clean-tmp", action="store_true",
+                    help="sweep stale *.tmp remnants (older than 1h)")
+    ap.add_argument("--strict", action="store_true",
+                    help="fail on manifest-less legacy checkpoints too")
+    args = ap.parse_args(argv)
+
+    from oktopk_tpu.train.durable import (clean_stale_tmp, read_manifest,
+                                          scan_checkpoints,
+                                          verify_checkpoint)
+
+    if os.path.isdir(args.path):
+        entries = scan_checkpoints(args.path, args.prefix, clean_tmp=False)
+        paths = [p for _, p in entries]
+        if args.clean_tmp:
+            for tmp in clean_stale_tmp(args.path):
+                print(f"swept   {tmp}")
+    elif os.path.exists(args.path):
+        paths = [args.path]
+    else:
+        print(f"error: {args.path} does not exist", file=sys.stderr)
+        return 2
+
+    if not paths:
+        print(f"no '{args.prefix}-*.msgpack' checkpoints under {args.path}")
+        return 1
+
+    corrupt = legacy = ok = 0
+    for p in paths:
+        v = verify_checkpoint(p, deep=args.deep)
+        man = read_manifest(p)
+        if not v.ok:
+            corrupt += 1
+            print(f"CORRUPT {p}: {v.reason}")
+        elif v.legacy:
+            legacy += 1
+            print(f"legacy  {p}: no manifest (restores unverified)")
+        else:
+            ok += 1
+            q = "" if v.qualified else "  [mid-incident]"
+            print(f"ok      {p}  {man.get('bytes', '?')} B  "
+                  f"{man.get('digest', '?')}{q}")
+
+    print(f"\n{ok} ok, {legacy} legacy, {corrupt} corrupt "
+          f"of {len(paths)} checkpoint(s)")
+    if corrupt or (args.strict and legacy):
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

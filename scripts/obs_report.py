@@ -12,8 +12,9 @@ Sections (each omitted when the journal has no matching events):
 - autotune decision log (per-bucket chosen algorithm + reason)
 - host phase table (latest ``phase`` event)
 - incident timeline: faults, guard trips, fallbacks, restores,
-  checkpoints, trace captures, regressions, remeshes, forced re-tunes
-  and density backoffs in step order
+  checkpoints (including durable-plane saves, verification failures and
+  verified restores), trace captures, regressions, remeshes, forced
+  re-tunes and density backoffs in step order
 
 Works on any JSONL journal that validates against
 ``oktopk_tpu.obs.events`` (see docs/OBSERVABILITY.md).
@@ -31,6 +32,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 # events rendered on the incident timeline, in journal order
 _INCIDENT_EVENTS = ("fault_seen", "guard_trip", "fallback", "restore",
                     "restore_unavailable", "checkpoint",
+                    "ckpt_saved", "ckpt_verify_failed", "ckpt_restore",
                     "trace_captured", "regression", "remesh", "retune",
                     "density_backoff")
 
@@ -150,6 +152,19 @@ def _timeline_lines(entries: List[Dict[str, Any]]) -> List[str]:
         elif ev == "checkpoint":
             q = "" if e.get("qualified") else " (NOT a restore target)"
             detail = f"{e.get('path')}{q}"
+        elif ev == "ckpt_saved":
+            q = "" if e.get("qualified", True) else " (mid-incident)"
+            detail = (f"{e.get('path')} "
+                      f"{_fmt_bytes(float(e.get('bytes', 0)))} "
+                      f"[{e.get('source', 'sync')}]{q}")
+        elif ev == "ckpt_verify_failed":
+            detail = f"{e.get('path')}: {e.get('reason')}"
+        elif ev == "ckpt_restore":
+            depth = e.get("fallback_depth", 0)
+            fb = f" (fell back past {depth} corrupt)" if depth else ""
+            legacy = " [legacy, unverified]" if e.get("legacy") else ""
+            detail = (f"restored {e.get('path')} @ "
+                      f"{e.get('ckpt_step', '?')}{fb}{legacy}")
         elif ev == "trace_captured":
             detail = (f"{e.get('num_steps')} steps from "
                       f"{e.get('start_step')} -> {e.get('logdir')} "

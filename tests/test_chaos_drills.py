@@ -129,7 +129,7 @@ class TestDensityBackoff:
 class TestDrills:
     def test_catalog_complete(self):
         assert set(DRILLS) == {"chip_loss", "latency_retune",
-                               "density_backoff"}
+                               "density_backoff", "ckpt_corruption"}
         with pytest.raises(KeyError):
             run_drill("meteor_strike")
 
@@ -145,6 +145,15 @@ class TestDrills:
         re-calibrate + re-tune -> plan flips to the latency-tolerant
         algorithm and step time recovers."""
         report = run_drill("latency_retune", mesh=mesh4)
+        assert report.ok, "\n" + report.summary()
+
+    def test_ckpt_corruption_drill(self, mesh8):
+        """Restore target damaged (truncate / bitflip / torn) -> the
+        divergence restore falls back to the older verified checkpoint
+        bit-identically, journal shows ckpt_verify_failed ->
+        ckpt_restore -> restore in order, async save drains whole at
+        exit, legacy manifest-less files still restore."""
+        report = run_drill("ckpt_corruption", mesh=mesh8)
         assert report.ok, "\n" + report.summary()
 
     def test_density_backoff_drill(self, mesh4):

@@ -1,6 +1,9 @@
 """Checkpoint/resume tests — including sparse-algorithm state fidelity,
 the reference's known gap (residuals never saved, SURVEY.md §5.4)."""
 
+import os
+
+import jax
 import numpy as np
 import pytest
 
@@ -180,6 +183,156 @@ class TestCheckpoint:
         np.testing.assert_array_equal(
             np.asarray(jax.tree.leaves(restored.params)[0]),
             np.asarray(jax.tree.leaves(trained.state.params)[0]))
+
+
+class TestVerifyingRestore:
+    """The durable state plane's restore path (ISSUE 7): corrupt
+    checkpoints are convicted against their manifests and restore falls
+    back newest -> oldest to a verified file, journalling the walk."""
+
+    def _events(self):
+        from oktopk_tpu.obs.journal import EventBus
+        bus, seen = EventBus(), []
+        bus.subscribe(lambda e: seen.append(dict(e)))
+        return bus, seen
+
+    def test_save_writes_manifest(self, trained, tmp_path):
+        from oktopk_tpu.train.durable import read_manifest, verify_checkpoint
+        path = save_checkpoint(str(tmp_path), trained.state, step=3)
+        man = read_manifest(path)
+        assert man is not None
+        assert man["step"] == 3
+        assert man["bytes"] == os.path.getsize(path)
+        assert man["digest"].startswith("crc32:")
+        assert man["qualified"] is True
+        v = verify_checkpoint(path)
+        assert v.ok and not v.legacy
+
+    def test_truncated_newest_falls_back(self, trained, tmp_path):
+        from oktopk_tpu.resilience.faults import corrupt_checkpoint
+        save_checkpoint(str(tmp_path), trained.state, step=2)
+        p4 = save_checkpoint(str(tmp_path), trained.state, step=4)
+        corrupt_checkpoint(p4, "ckpt_truncate")
+
+        bus, seen = self._events()
+        fresh = Trainer(trained.cfg, mesh=trained.mesh, warmup=False)
+        restored, step = restore_checkpoint(str(tmp_path), fresh.state,
+                                            bus=bus)
+        assert step == 2
+        kinds = [e["event"] for e in seen]
+        assert kinds == ["ckpt_verify_failed", "ckpt_restore"]
+        assert seen[0]["path"].endswith("ckpt-4.msgpack")
+        assert seen[0]["reason"].startswith("size_mismatch")
+        assert seen[1]["path"].endswith("ckpt-2.msgpack")
+        assert seen[1]["fallback_depth"] == 1
+        import jax
+        for a, b in zip(jax.tree.leaves(trained.state),
+                        jax.tree.leaves(restored)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_flipped_byte_fails_digest(self, trained, tmp_path):
+        from oktopk_tpu.resilience.faults import corrupt_checkpoint
+        from oktopk_tpu.train.durable import verify_checkpoint
+        save_checkpoint(str(tmp_path), trained.state, step=1)
+        p3 = save_checkpoint(str(tmp_path), trained.state, step=3)
+        corrupt_checkpoint(p3, "ckpt_bitflip")
+        v = verify_checkpoint(p3)
+        assert not v.ok and v.reason == "digest_mismatch"
+
+        bus, seen = self._events()
+        fresh = Trainer(trained.cfg, mesh=trained.mesh, warmup=False)
+        _, step = restore_checkpoint(str(tmp_path), fresh.state, bus=bus)
+        assert step == 1
+        assert seen[0]["reason"] == "digest_mismatch"
+
+    def test_manifestless_legacy_accepted(self, trained, tmp_path):
+        """Checkpoints predating the durable plane restore fine, flagged
+        legacy on the journalled ckpt_restore event."""
+        save_checkpoint(str(tmp_path), trained.state, step=5,
+                        manifest=False)
+        bus, seen = self._events()
+        fresh = Trainer(trained.cfg, mesh=trained.mesh, warmup=False)
+        _, step = restore_checkpoint(str(tmp_path), fresh.state, bus=bus)
+        assert step == 5
+        assert seen[-1]["event"] == "ckpt_restore"
+        assert seen[-1]["legacy"] is True
+
+    def test_all_corrupt_raises(self, trained, tmp_path):
+        from oktopk_tpu.resilience.faults import corrupt_checkpoint
+        p = save_checkpoint(str(tmp_path), trained.state, step=1)
+        corrupt_checkpoint(p, "ckpt_truncate")
+        fresh = Trainer(trained.cfg, mesh=trained.mesh, warmup=False)
+        with pytest.raises(FileNotFoundError, match="all failed"):
+            restore_checkpoint(str(tmp_path), fresh.state)
+
+    def test_torn_write_leaves_no_partial_and_sweeps_tmp(
+            self, trained, tmp_path):
+        """atomic_write_bytes never exposes a partial file; a stale
+        *.tmp remnant from a crashed writer is swept by the scan once
+        old enough (an in-flight one is left alone)."""
+        save_checkpoint(str(tmp_path), trained.state, step=1)
+        remnant = str(tmp_path / "ckpt-9.msgpack.tmp")
+        with open(remnant, "wb") as f:
+            f.write(b"half a checkpoint")
+        # fresh remnant: could be an in-flight async write — kept
+        assert latest_checkpoint(str(tmp_path)).endswith("ckpt-1.msgpack")
+        assert os.path.exists(remnant)
+        os.utime(remnant, (0, 0))  # age it past the stale threshold
+        latest_checkpoint(str(tmp_path))
+        assert not os.path.exists(remnant)
+
+    def test_merge_escalation_and_force(self, trained, tmp_path):
+        """A checkpoint for a different model (most leaves mismatched)
+        raises, naming --ckpt-force; force restores with the warning."""
+        path = save_checkpoint(str(tmp_path),
+                               {"bogus": {"w": np.zeros(3, np.float32)}},
+                               step=2)
+        fresh = Trainer(trained.cfg, mesh=trained.mesh, warmup=False)
+        with pytest.raises(ValueError, match="ckpt-force"):
+            restore_checkpoint(path, fresh.state)
+        restored, step = restore_checkpoint(path, fresh.state, force=True)
+        assert step == 2
+        np.testing.assert_array_equal(
+            np.asarray(jax.tree.leaves(restored.params)[0]),
+            np.asarray(jax.tree.leaves(fresh.state.params)[0]))
+
+    def test_wrong_model_does_not_fall_back(self, trained, tmp_path):
+        """The escalation must fire even when an older checkpoint
+        exists: a wrong --model should fail loudly, not silently
+        restore a different (equally wrong) older file."""
+        save_checkpoint(str(tmp_path),
+                        {"bogus": {"w": np.zeros(3, np.float32)}}, step=1)
+        save_checkpoint(str(tmp_path),
+                        {"bogus": {"w": np.ones(3, np.float32)}}, step=2)
+        fresh = Trainer(trained.cfg, mesh=trained.mesh, warmup=False)
+        with pytest.raises(ValueError, match="ckpt-force"):
+            restore_checkpoint(str(tmp_path), fresh.state)
+
+    def test_restore_and_extra_share_one_decode(self, trained, tmp_path,
+                                                monkeypatch):
+        """restore_checkpoint + load_extra on the same file pay one
+        msgpack decode (the resume path reads both)."""
+        import flax.serialization as fser
+        from oktopk_tpu.train import checkpoint as ckpt
+        from oktopk_tpu.train.checkpoint import load_extra
+
+        extra = {"supervisor": {"strikes": [0], "forced_dense": [],
+                                "last_good_step": 3}}
+        save_checkpoint(str(tmp_path), trained.state, step=3, extra=extra)
+        ckpt._READ_CACHE.clear()
+        calls = {"n": 0}
+        real = fser.msgpack_restore
+
+        def counting(data):
+            calls["n"] += 1
+            return real(data)
+
+        monkeypatch.setattr(fser, "msgpack_restore", counting)
+        fresh = Trainer(trained.cfg, mesh=trained.mesh, warmup=False)
+        _, step = restore_checkpoint(str(tmp_path), fresh.state)
+        assert load_extra(str(tmp_path)) == extra
+        assert step == 3
+        assert calls["n"] == 1
 
 
 class TestSupervisorCheckpoint:

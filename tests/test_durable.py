@@ -1,0 +1,327 @@
+"""Durable state plane units (ISSUE 7): manifests, verification,
+candidate walks, retention/pinning, the async checkpointer's queue /
+drain / failure-escalation contract, checkpoint-corruption faults, and
+the offline fsck CLI. Host-only — states are plain numpy dicts."""
+
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from oktopk_tpu.train import durable
+from oktopk_tpu.train.checkpoint import save_checkpoint
+from oktopk_tpu.train.durable import (
+    AsyncCheckpointer,
+    apply_retention,
+    atomic_write_bytes,
+    candidate_paths,
+    clean_stale_tmp,
+    compute_digest,
+    manifest_path,
+    read_manifest,
+    scan_checkpoints,
+    verify_checkpoint,
+    write_manifest,
+)
+
+
+def _state(n=8, fill=0.0):
+    return {"w": np.full((n,), fill, np.float32)}
+
+
+class TestDigestsAndManifests:
+    def test_compute_digest_stable_and_prefixed(self):
+        d = compute_digest(b"hello")
+        assert d == compute_digest(b"hello")
+        assert d.startswith("crc32:") and len(d) == len("crc32:") + 8
+        assert d != compute_digest(b"hellp")
+
+    def test_unknown_algo_raises(self):
+        with pytest.raises(ValueError, match="unknown digest algo"):
+            compute_digest(b"x", algo="md5000")
+
+    def test_unknown_recorded_algo_is_unverifiable_not_corrupt(
+            self, tmp_path):
+        path = str(tmp_path / "ckpt-1.msgpack")
+        atomic_write_bytes(path, b"payload")
+        man = write_manifest(path, 1, b"payload")
+        man["digest"] = "sha3-512:deadbeef"
+        atomic_write_bytes(manifest_path(path),
+                           json.dumps(man).encode())
+        v = verify_checkpoint(path)
+        assert v.ok and v.reason == "digest_unverifiable"
+
+    def test_manifest_roundtrip(self, tmp_path):
+        path = str(tmp_path / "ckpt-12.msgpack")
+        atomic_write_bytes(path, b"\x00" * 64)
+        man = write_manifest(path, 12, b"\x00" * 64, qualified=False)
+        assert manifest_path(path).endswith("ckpt-12.manifest.json")
+        back = read_manifest(path)
+        assert back == man
+        assert back["bytes"] == 64 and back["qualified"] is False
+        assert "schema_version" in back["environment"]
+
+    def test_read_manifest_absent_or_garbage(self, tmp_path):
+        path = str(tmp_path / "ckpt-1.msgpack")
+        assert read_manifest(path) is None
+        with open(manifest_path(path), "w") as f:
+            f.write("{not json")
+        assert read_manifest(path) is None
+
+
+class TestVerifyCheckpoint:
+    def _published(self, tmp_path, step=1, data=b"x" * 100):
+        path = str(tmp_path / f"ckpt-{step}.msgpack")
+        atomic_write_bytes(path, data)
+        write_manifest(path, step, data)
+        return path, data
+
+    def test_ok(self, tmp_path):
+        path, _ = self._published(tmp_path)
+        v = verify_checkpoint(path)
+        assert v.ok and v.reason == "ok" and not v.legacy
+
+    def test_missing_and_empty(self, tmp_path):
+        assert verify_checkpoint(str(tmp_path / "nope.msgpack")).reason \
+            == "missing_file"
+        empty = str(tmp_path / "ckpt-1.msgpack")
+        open(empty, "wb").close()
+        assert verify_checkpoint(empty).reason == "empty_file"
+
+    def test_truncation_is_size_mismatch(self, tmp_path):
+        path, data = self._published(tmp_path)
+        with open(path, "wb") as f:
+            f.write(data[: len(data) // 2])
+        v = verify_checkpoint(path)
+        assert not v.ok and v.reason.startswith("size_mismatch")
+
+    def test_bitflip_is_digest_mismatch(self, tmp_path):
+        path, data = self._published(tmp_path)
+        flipped = bytearray(data)
+        flipped[50] ^= 0x40
+        with open(path, "wb") as f:
+            f.write(bytes(flipped))
+        v = verify_checkpoint(path)
+        assert not v.ok and v.reason == "digest_mismatch"
+
+    def test_no_manifest_is_legacy_ok(self, tmp_path):
+        path = str(tmp_path / "ckpt-1.msgpack")
+        atomic_write_bytes(path, b"old format")
+        v = verify_checkpoint(path)
+        assert v.ok and v.legacy and v.reason == "no_manifest"
+
+    def test_deep_decodes_msgpack(self, tmp_path):
+        path = save_checkpoint(str(tmp_path), _state(), 1)
+        assert verify_checkpoint(path, deep=True).ok
+        # a legacy file of garbage passes shallow, fails deep
+        bad = str(tmp_path / "ckpt-2.msgpack")
+        atomic_write_bytes(bad, b"\xc1" * 64)  # 0xc1: reserved in msgpack
+        assert verify_checkpoint(bad).ok
+        v = verify_checkpoint(bad, deep=True)
+        assert not v.ok and v.reason.startswith("decode_error")
+
+
+class TestScanAndCandidates:
+    def test_scan_orders_newest_first_and_skips_junk(self, tmp_path):
+        for s in (3, 10, 1):
+            save_checkpoint(str(tmp_path), _state(), s)
+        (tmp_path / "ckpt-notastep.msgpack").write_bytes(b"x")
+        (tmp_path / "other-5.msgpack").write_bytes(b"x")
+        assert [s for s, _ in scan_checkpoints(str(tmp_path))] == [10, 3, 1]
+
+    def test_candidates_for_dir_and_file(self, tmp_path):
+        paths = {s: save_checkpoint(str(tmp_path), _state(), s)
+                 for s in (2, 4, 6)}
+        assert candidate_paths(str(tmp_path)) \
+            == [paths[6], paths[4], paths[2]]
+        # a named file yields itself, then strictly-older siblings only
+        assert candidate_paths(paths[4]) == [paths[4], paths[2]]
+
+    def test_clean_stale_tmp_age_gated(self, tmp_path):
+        fresh = tmp_path / "a.tmp"
+        stale = tmp_path / "b.tmp"
+        fresh.write_bytes(b"x")
+        stale.write_bytes(b"x")
+        os.utime(stale, (0, 0))
+        removed = clean_stale_tmp(str(tmp_path))
+        assert removed == [str(stale)]
+        assert fresh.exists() and not stale.exists()
+
+
+class TestRetention:
+    def test_keeps_last_n_plus_newest_qualified(self, tmp_path):
+        # steps 1..5; 4 and 5 are mid-incident (not qualified)
+        for s in (1, 2, 3):
+            save_checkpoint(str(tmp_path), _state(), s)
+        for s in (4, 5):
+            save_checkpoint(str(tmp_path), _state(), s, qualified=False)
+        deleted = apply_retention(str(tmp_path), keep_last=2)
+        steps = [s for s, _ in scan_checkpoints(str(tmp_path))]
+        # newest 2 (5, 4) kept + newest qualified (3) pinned
+        assert steps == [5, 4, 3]
+        assert len(deleted) == 2
+        for p in deleted:
+            assert not os.path.exists(p)
+            assert not os.path.exists(durable.manifest_path(p))
+
+    def test_zero_disables(self, tmp_path):
+        for s in (1, 2, 3):
+            save_checkpoint(str(tmp_path), _state(), s)
+        assert apply_retention(str(tmp_path), keep_last=0) == []
+        assert len(scan_checkpoints(str(tmp_path))) == 3
+
+
+class TestAsyncCheckpointer:
+    def test_save_verify_counters_and_context_manager(self, tmp_path):
+        with AsyncCheckpointer(str(tmp_path)) as ac:
+            p = ac.save(_state(), 1)
+            assert p.endswith("ckpt-1.msgpack")
+            assert ac.drain(timeout=60)
+            assert ac.saves == 1 and ac.write_failures == 0
+            assert ac.last_path == p
+            assert verify_checkpoint(p).ok
+        with pytest.raises(RuntimeError):
+            ac.save(_state(), 2)
+
+    def test_retention_applied_by_worker(self, tmp_path):
+        with AsyncCheckpointer(str(tmp_path), keep_last=2) as ac:
+            for s in (1, 2, 3, 4):
+                ac.save(_state(fill=float(s)), s)
+            ac.drain(timeout=60)
+        assert [s for s, _ in scan_checkpoints(str(tmp_path))] == [4, 3]
+
+    def test_write_failure_escalates(self, tmp_path):
+        """An unwritable target journals ckpt_verify_failed
+        (write_failed) and invokes on_failure — never silently lost."""
+        from oktopk_tpu.obs.journal import EventBus
+
+        bus, seen, failures = EventBus(), [], []
+        bus.subscribe(lambda e: seen.append(dict(e)))
+        target = tmp_path / "ckpts"
+        target.write_text("a file, not a dir")  # makedirs will raise
+        with AsyncCheckpointer(str(target), bus=bus,
+                               on_failure=lambda s, p, e:
+                               failures.append((s, type(e).__name__))) as ac:
+            ac.save(_state(), 7)
+            ac.drain(timeout=60)
+            assert ac.write_failures == 1 and ac.saves == 0
+        assert failures and failures[0][0] == 7
+        assert seen[0]["event"] == "ckpt_verify_failed"
+        assert seen[0]["reason"].startswith("write_failed")
+
+    def test_on_failure_exception_does_not_kill_worker(self, tmp_path):
+        target = tmp_path / "ckpts"
+        target.write_text("not a dir")
+
+        def boom(*a):
+            raise RuntimeError("escalation handler crashed")
+
+        with AsyncCheckpointer(str(target), on_failure=boom) as ac:
+            ac.save(_state(), 1)
+            ac.save(_state(), 2)
+            assert ac.drain(timeout=60)
+            assert ac.write_failures == 2
+
+    def test_bounded_queue_blocks_not_drops(self, tmp_path):
+        """With the worker wedged, a queue_depth of 1 makes the third
+        save block (throttle) rather than drop or error; everything
+        still publishes once the worker resumes."""
+        gate = threading.Event()
+        orig = durable.verify_checkpoint
+
+        def slow_verify(path, deep=False):
+            gate.wait(timeout=30)
+            return orig(path, deep)
+
+        durable.verify_checkpoint = slow_verify
+        try:
+            ac = AsyncCheckpointer(str(tmp_path), queue_depth=1)
+            ac.save(_state(), 1)          # worker picks this up, wedges
+            time.sleep(0.2)
+            ac.save(_state(), 2)          # fills the queue
+            t0 = time.monotonic()
+            blocker = threading.Thread(target=ac.save,
+                                       args=(_state(), 3))
+            blocker.start()
+            blocker.join(timeout=0.5)
+            assert blocker.is_alive()     # blocked on the full queue
+            gate.set()
+            blocker.join(timeout=30)
+            assert not blocker.is_alive()
+            assert ac.drain(timeout=60)
+            assert ac.saves == 3
+        finally:
+            durable.verify_checkpoint = orig
+            gate.set()
+            ac.close(timeout=30)
+
+
+class TestCorruptionFaults:
+    def test_kinds_registered(self):
+        from oktopk_tpu.resilience.faults import FAULT_KINDS
+        for k in ("ckpt_truncate", "ckpt_bitflip", "ckpt_torn"):
+            assert k in FAULT_KINDS
+
+    def test_each_kind_fails_verification(self, tmp_path):
+        from oktopk_tpu.resilience.faults import corrupt_checkpoint
+
+        expect = {"ckpt_truncate": "size_mismatch",
+                  "ckpt_bitflip": "digest_mismatch",
+                  "ckpt_torn": "size_mismatch"}
+        for kind, reason in expect.items():
+            d = tmp_path / kind
+            p = save_checkpoint(str(d), _state(64), 1)
+            corrupt_checkpoint(p, kind)
+            v = verify_checkpoint(p)
+            assert not v.ok and v.reason.startswith(reason), (kind, v)
+        # torn also leaves the crashed writer's *.tmp remnant behind
+        torn_tmp = tmp_path / "ckpt_torn" / "ckpt-1.msgpack.tmp"
+        assert torn_tmp.exists()
+
+    def test_non_ckpt_kind_rejected(self, tmp_path):
+        from oktopk_tpu.resilience.faults import corrupt_checkpoint
+        p = save_checkpoint(str(tmp_path), _state(), 1)
+        with pytest.raises(ValueError):
+            corrupt_checkpoint(p, "nan_grad")
+
+
+class TestFsckCli:
+    def _run(self, *argv):
+        import importlib.util
+        path = os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "scripts", "ckpt_fsck.py")
+        spec = importlib.util.spec_from_file_location("ckpt_fsck", path)
+        fsck = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(fsck)
+        return fsck.main(list(argv))
+
+    def test_clean_dir_exits_zero(self, tmp_path, capsys):
+        for s in (1, 2):
+            save_checkpoint(str(tmp_path), _state(), s)
+        assert self._run(str(tmp_path)) == 0
+        out = capsys.readouterr().out
+        assert "2 ok, 0 legacy, 0 corrupt" in out
+
+    def test_corrupt_file_exits_nonzero(self, tmp_path, capsys):
+        from oktopk_tpu.resilience.faults import corrupt_checkpoint
+        save_checkpoint(str(tmp_path), _state(), 1)
+        p = save_checkpoint(str(tmp_path), _state(), 2)
+        corrupt_checkpoint(p, "ckpt_bitflip")
+        assert self._run(str(tmp_path), "--deep") == 1
+        out = capsys.readouterr().out
+        assert "CORRUPT" in out and "digest_mismatch" in out
+
+    def test_legacy_ok_unless_strict(self, tmp_path, capsys):
+        save_checkpoint(str(tmp_path), _state(), 1, manifest=False)
+        assert self._run(str(tmp_path)) == 0
+        assert "legacy" in capsys.readouterr().out
+        assert self._run(str(tmp_path), "--strict") == 1
+
+    def test_missing_path_exits_two_and_empty_dir_one(self, tmp_path):
+        assert self._run(str(tmp_path / "gone")) == 2
+        empty = tmp_path / "empty"
+        empty.mkdir()
+        assert self._run(str(empty)) == 1

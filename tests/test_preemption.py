@@ -82,6 +82,33 @@ class TestInterruptedState:
         parked = interrupted_state_path(str(tmp_path), job_id="j9") + ".d"
         assert load_extra(parked) == extra
 
+    def test_park_writes_manifest_and_unparks_verified(self, tmp_path):
+        """Parked state goes through the durable plane: manifest sidecar
+        written, unpark goes through the verifying restore."""
+        from oktopk_tpu.train.durable import read_manifest, verify_checkpoint
+        from oktopk_tpu.train.preemption import interrupted_state_path
+
+        state = {"w": np.arange(4, dtype=np.float32)}
+        path = save_interrupted_state(state, 9, state_dir=str(tmp_path),
+                                      job_id="jv")
+        assert read_manifest(path) is not None
+        assert verify_checkpoint(path).ok
+        out = load_interrupted_state({"w": np.zeros(4, np.float32)},
+                                     state_dir=str(tmp_path), job_id="jv")
+        assert out is not None and out[1] == 9
+
+    def test_corrupt_parked_state_not_restored(self, tmp_path):
+        """A torn/corrupted parked file fails verification; unpark
+        reports nothing parked instead of loading garbage."""
+        from oktopk_tpu.resilience.faults import corrupt_checkpoint
+
+        path = save_interrupted_state({"w": np.zeros(8, np.float32)}, 5,
+                                      state_dir=str(tmp_path), job_id="jc")
+        corrupt_checkpoint(path, "ckpt_truncate")
+        assert load_interrupted_state({"w": np.zeros(8, np.float32)},
+                                      state_dir=str(tmp_path),
+                                      job_id="jc") is None
+
     def test_clear(self, tmp_path):
         save_interrupted_state({"x": np.zeros(2)}, 1,
                                state_dir=str(tmp_path), job_id="j2")
@@ -89,6 +116,56 @@ class TestInterruptedState:
         assert load_interrupted_state({"x": np.zeros(2)},
                                       state_dir=str(tmp_path),
                                       job_id="j2") is None
+
+
+class TestEpilogueDrain:
+    """The exit barrier: a save still queued in the AsyncCheckpointer
+    when the preemption signal lands must publish whole before the
+    process exits (epilogue drains FIRST, whatever the exit reason)."""
+
+    def _logger(self):
+        import logging
+        return logging.getLogger("oktopk_tpu.test")
+
+    def test_epilogue_drains_queued_save(self, tmp_path):
+        from oktopk_tpu.train.durable import AsyncCheckpointer, \
+            verify_checkpoint
+        from oktopk_tpu.train.preemption import epilogue
+
+        ac = AsyncCheckpointer(str(tmp_path / "ckpts"))
+        try:
+            path = ac.save({"w": np.zeros((256, 256), np.float32)}, 7)
+            rc = epilogue(None, 7, preempt=None, logger=self._logger(),
+                          completed=True, state_dir=str(tmp_path / "park"),
+                          checkpointer=ac)
+            assert rc == 0
+            assert ac.saves == 1
+            assert verify_checkpoint(path).ok
+            assert not [f for f in os.listdir(tmp_path / "ckpts")
+                        if f.endswith(".tmp")]
+        finally:
+            ac.close(timeout=30)
+
+    def test_epilogue_drains_even_when_preempted(self, tmp_path):
+        from oktopk_tpu.train.durable import AsyncCheckpointer, \
+            verify_checkpoint
+        from oktopk_tpu.train.preemption import epilogue
+
+        h = PreemptionHandler(exit_signals=(signal.SIGUSR2,),
+                              requeue_signals=())
+        ac = AsyncCheckpointer(str(tmp_path / "ckpts"))
+        try:
+            os.kill(os.getpid(), signal.SIGUSR2)
+            path = ac.save({"w": np.zeros(16, np.float32)}, 3)
+            rc = epilogue({"w": np.zeros(16, np.float32)}, 3, preempt=h,
+                          logger=self._logger(),
+                          state_dir=str(tmp_path / "park"),
+                          checkpointer=ac)
+            assert rc == 3
+            assert verify_checkpoint(path).ok  # drained before parking
+        finally:
+            ac.close(timeout=30)
+            h.uninstall()
 
 
 class TestRequeue:
