@@ -64,12 +64,24 @@ def volume_probe():
     rng = np.random.RandomState(0)
     base = rng.randn(P, n).astype(np.float32)
     vols, wires = [], []
+    comp_errs, eff_dens, res_norms = [], [], []
     for i in range(13):
-        grads = jnp.asarray(base + 0.3 * rng.randn(P, n).astype(np.float32))
-        _, state = step(grads, state)
+        grads = base + 0.3 * rng.randn(P, n).astype(np.float32)
+        # offline dense-vs-sparse oracle (mirrors the in-jit quality tap,
+        # obs/quality.py): what an exact allreduce of gradient + carried
+        # residual would have delivered this step
+        res_before = np.asarray(state.residual, dtype=np.float64)
+        dense = (grads.astype(np.float64) + res_before).mean(0)
+        reduced, state = step(jnp.asarray(grads), state)
         if i % 4 != 0:   # steady-state predicted steps
             vols.append(float(state.last_volume[0]))
             wires.append(float(state.last_wire_bytes[0]))
+            r = np.asarray(reduced[0], dtype=np.float64)
+            comp_errs.append(float(((r - dense) ** 2).sum()
+                                   / ((dense ** 2).sum() + 1e-30)))
+            eff_dens.append(float((r != 0).sum()) / n)
+            res_norms.append(float(np.mean(np.sqrt(
+                (np.asarray(state.residual, np.float64) ** 2).sum(-1)))))
     from oktopk_tpu.obs.volume import budget_bytes
     budget = budget_bytes("oktopk", cfg)
     mean_wire = sum(wires) / len(wires)
@@ -84,7 +96,13 @@ def volume_probe():
            # means the O(k) volume claim held on the wire
            "wire_bytes": mean_wire,
            "volume_budget_bytes": budget,
-           "conformance_ratio": mean_wire / budget}
+           "conformance_ratio": mean_wire / budget,
+           # signal fidelity (steady-state means, offline oracle — the
+           # same definitions the in-jit taps journal; watchable via
+           # RegressionDetector.quality_limits)
+           "quality_comp_err": sum(comp_errs) / len(comp_errs),
+           "quality_eff_density": sum(eff_dens) / len(eff_dens),
+           "quality_res_norm": sum(res_norms) / len(res_norms)}
     print("VOLUME_PROBE " + json.dumps(out))
 
 
@@ -404,6 +422,13 @@ def main():
                     "conformance_ratio"):
             if key in probe:
                 rec[key] = round(float(probe[key]), 3)
+        # offline signal-fidelity oracle (same definitions as the in-jit
+        # quality taps) — carried so the BENCH trajectory can baseline
+        # fidelity drift, not just step time and volume
+        for key in ("quality_comp_err", "quality_eff_density",
+                    "quality_res_norm"):
+            if key in probe:
+                rec[key] = round(float(probe[key]), 6)
         for key in ("device", "oktopk_ms", "oktopk_ms_std", "dense_ms",
                     "dense_ms_std", "dense_bs256_ms", "dense_bs256_ms_std",
                     "oktopk_bs256_ms", "oktopk_bs256_ms_std",

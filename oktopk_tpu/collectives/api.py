@@ -70,6 +70,48 @@ def build_allreduce_step(name: str, cfg: OkTopkConfig, mesh: Mesh,
     return jax.jit(mapped)
 
 
+def build_quality_allreduce_step(name: str, cfg: OkTopkConfig, mesh: Mesh,
+                                 quality, axis_name: str = "data",
+                                 warmup: bool = True,
+                                 check_vma: bool = True):
+    """``build_allreduce_step`` plus the in-jit signal-fidelity tap:
+    ``(grads [P, n], state, qbuf) -> (results, state, qbuf)``.
+
+    ``quality`` is an ``obs.quality.QualityConfig``; ``qbuf`` a batched
+    ``obs.metrics_buffer.QualityBuffer`` ([P, ...] leaves, e.g. from
+    broadcasting ``init_buffer`` like :func:`batched_init_state` does).
+    The tap is the EXACT code path the trainer threads through
+    ``optim.build_sparse_grad_step`` — same ``measure_bucket``, same
+    ring commit — so the dense-vs-sparse oracle tests
+    (tests/test_quality.py) validate what training runs journal, not a
+    reimplementation."""
+    from oktopk_tpu.obs.quality import commit, measure_bucket
+    from oktopk_tpu.ops.compaction import resolve_use_pallas
+    from jax import lax
+    cfg = resolve_use_pallas(cfg, mesh)
+    algo = get_algorithm(name, warmup=warmup)
+    spec = P(axis_name)
+    del quality  # static config lives in the buffer's shapes
+
+    def shard_fn(g, s, q):
+        g1 = g[0]
+        s1 = jax.tree.map(lambda x: x[0], s)
+        q1 = jax.tree.map(lambda x: x[0], q)
+        out, s2 = algo(g1, s1, cfg, axis_name)
+        dense = lax.pmean(g1 + s1.residual, axis_name)
+        scalars = measure_bucket(out, dense, s2, q1.prev_sig,
+                                 q1.prev_res_norm)
+        q2 = commit(q1, s2.step, scalars, jnp.asarray(False))
+        return (out[None], jax.tree.map(lambda x: x[None], s2),
+                jax.tree.map(lambda x: x[None], q2))
+
+    mapped = compat.shard_map(shard_fn, mesh=mesh,
+                              in_specs=(spec, spec, spec),
+                              out_specs=(spec, spec, spec),
+                              check_vma=check_vma)
+    return jax.jit(mapped)
+
+
 def time_allreduce_step(step_fn, grads, state, iters: int = 3,
                         warmup_iters: int = 1):
     """Honest per-step wall times of a ``build_allreduce_step`` program.

@@ -439,6 +439,29 @@ class TrainConfig:
     obs_regress_key: Optional[str] = None
     # Step time above tolerance x baseline journals a regression event.
     obs_regress_tolerance: float = 1.5
+    # ---- signal-fidelity telemetry (obs/quality.py) -------------------
+    # When True (with obs) the jitted step computes per-bucket fidelity
+    # scalars — compression error vs the pre-selection dense gradient,
+    # residual norm/growth, realised density, threshold drift, winner
+    # churn — into a device-side ring (obs/metrics_buffer.py) flushed
+    # to `quality` journal events; obs/rollup.py aggregates them with
+    # breach detection feeding the closed-loop seams.
+    obs_quality: bool = False
+    # Flush cadence in steps (= ring capacity). Steady state pays NO
+    # per-step host sync; each flush is one device_get.
+    obs_quality_every: int = 32
+    # Churn-signature bins (power of two; obs/quality.py).
+    obs_quality_sig_bins: int = 512
+    # Breach thresholds (obs/rollup.py): window-mean residual growth
+    # ratio above this flags residual_growth ...
+    obs_quality_growth_limit: float = 1.5
+    # ... realised density below this fraction of the bucket target
+    # flags density_collapse ...
+    obs_quality_collapse_ratio: float = 0.25
+    # ... mean winner churn above this flags churn_spike ...
+    obs_quality_churn_limit: float = 0.9
+    # ... and mean compression error above this flags comp_err.
+    obs_quality_comp_err_limit: float = 1.0
 
     def experiment_slug(self) -> str:
         """Reference experiment naming convention

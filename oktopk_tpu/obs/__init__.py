@@ -17,5 +17,18 @@ callers import the submodules directly:
     ``jax.profiler`` windows armed by guard trips) and
     :class:`ChromeTraceSink` (host-phase Chrome trace export).
   - :mod:`oktopk_tpu.obs.regress` — step-time regression detection
-    against the repo's BENCH_r*.json trajectory.
+    against the repo's BENCH_r*.json trajectory (plus quality-summary
+    watching and baseline-gap warnings).
+  - :mod:`oktopk_tpu.obs.quality` — in-jit signal-fidelity taps:
+    per-bucket compression error, residual growth, effective density,
+    threshold drift and winner-index churn (docs/OBSERVABILITY.md
+    "Signal fidelity").
+  - :mod:`oktopk_tpu.obs.metrics_buffer` — the device-side metric ring
+    the taps accumulate into (host flush only on the configured
+    cadence; zero steady-state syncs).
+  - :mod:`oktopk_tpu.obs.rollup` — windowed rollups over flushed
+    quality events with breach detection feeding the closed-loop
+    seams.
+  - :mod:`oktopk_tpu.obs.export` — Prometheus-textfile export of the
+    latest quality rollups.
 """

@@ -185,6 +185,40 @@ EVENT_SCHEMAS: Dict[str, Dict[str, Dict[str, tuple]]] = {
                      "ratio": _NUM},
         "optional": {"key": _OPT_STR, "tolerance": _NUM},
     },
+    # per-bucket signal-fidelity flush (obs/quality.py via the trainer):
+    # one event per bucket per flush window, carrying parallel per-step
+    # lists drained from the device-side metric ring. Non-finite values
+    # are sanitised to null at flush time (JSON has no NaN), so list
+    # entries are number-or-null.
+    "quality": {
+        "required": {"step": _NUM, "bucket": _NUM},
+        "optional": {"algo": _STR, "count": _NUM, "steps": _LIST,
+                     "comp_err": _LIST, "res_norm": _LIST,
+                     "res_growth": _LIST, "eff_density": _LIST,
+                     "thr_drift": _LIST, "churn": _LIST,
+                     "skipped": _LIST},
+    },
+    # windowed aggregate over one quality flush (obs/rollup.py
+    # RollupEngine) with breach detection — "breaches" names which
+    # fidelity invariants failed ("residual_growth", "density_collapse",
+    # "churn_spike", "comp_err"). Aggregate fields are omitted (not
+    # null) when every sample in the window was non-finite.
+    "quality_rollup": {
+        "required": {"step": _NUM, "bucket": _NUM, "breaches": _LIST},
+        "optional": {"algo": _STR, "window": _NUM, "skipped": _NUM,
+                     "comp_err_mean": _NUM, "comp_err_max": _NUM,
+                     "res_norm_mean": _NUM, "res_norm_last": _NUM,
+                     "res_growth_mean": _NUM, "res_growth_max": _NUM,
+                     "eff_density_mean": _NUM, "eff_density_min": _NUM,
+                     "thr_drift_mean": _NUM, "churn_mean": _NUM,
+                     "churn_max": _NUM, "target_density": _NUM},
+    },
+    # a detector could not build (or refused) its baseline — advisory,
+    # journalled instead of raising (obs/regress.py)
+    "baseline_warning": {
+        "required": {"step": _NUM, "key": _STR, "reason": _STR},
+        "optional": {"files": _NUM, "malformed": _LIST},
+    },
 }
 
 

@@ -1,0 +1,94 @@
+"""Prometheus-textfile export of the quality telemetry plane.
+
+Renders the LATEST ``quality_rollup`` per bucket (plus run-level
+counters) in the node-exporter textfile-collector format, so a run's
+fidelity posture can be scraped next to its host metrics without any
+bespoke collector:
+
+    python scripts/obs_report.py run_journal.jsonl --prom quality.prom
+
+Gauges carry ``bucket`` and ``algo`` labels; every exposition is
+self-describing (# HELP / # TYPE) and deterministic in ordering so
+textfile diffs are meaningful in CI.
+"""
+
+from __future__ import annotations
+
+import math
+import os
+from typing import Any, Dict, List
+
+_PREFIX = "oktopk_quality"
+
+# rollup field -> (metric suffix, help text)
+_GAUGES = (
+    ("comp_err_mean", "compression error ||g_hat-g||^2/||g||^2, window mean"),
+    ("comp_err_max", "compression error, window max"),
+    ("res_norm_mean", "error-feedback residual L2 norm, window mean"),
+    ("res_growth_mean", "step-over-step residual growth ratio, window mean"),
+    ("eff_density_mean", "realised selection density k_hat/n, window mean"),
+    ("eff_density_min", "realised selection density, window min"),
+    ("thr_drift_mean", "predicted/exact threshold ratio, window mean"),
+    ("churn_mean", "step-over-step winner-index churn, window mean"),
+)
+
+
+def _esc(v: Any) -> str:
+    return str(v).replace("\\", "\\\\").replace('"', '\\"')
+
+
+def render_prometheus(entries: List[Dict[str, Any]]) -> str:
+    """Prometheus exposition text from a journal's entries."""
+    latest: Dict[int, Dict[str, Any]] = {}
+    breaches: Dict[int, int] = {}
+    for e in entries:
+        if e.get("event") != "quality_rollup":
+            continue
+        b = int(e.get("bucket", 0))
+        latest[b] = e
+        breaches[b] = breaches.get(b, 0) + len(e.get("breaches") or [])
+    lines: List[str] = []
+    for field, help_text in _GAUGES:
+        name = f"{_PREFIX}_{field}"
+        samples = []
+        for b in sorted(latest):
+            v = latest[b].get(field)
+            if isinstance(v, (int, float)) and math.isfinite(float(v)):
+                labels = (f'bucket="{b}",'
+                          f'algo="{_esc(latest[b].get("algo", "?"))}"')
+                samples.append(f"{name}{{{labels}}} {float(v):.10g}")
+        if samples:
+            lines.append(f"# HELP {name} {help_text}")
+            lines.append(f"# TYPE {name} gauge")
+            lines.extend(samples)
+    if latest:
+        name = f"{_PREFIX}_breaches_total"
+        lines.append(f"# HELP {name} fidelity breaches flagged across "
+                     "the run's rollups")
+        lines.append(f"# TYPE {name} counter")
+        for b in sorted(latest):
+            labels = (f'bucket="{b}",'
+                      f'algo="{_esc(latest[b].get("algo", "?"))}"')
+            lines.append(f"{name}{{{labels}}} {breaches.get(b, 0)}")
+        name = f"{_PREFIX}_last_step"
+        lines.append(f"# HELP {name} journal step of the newest rollup")
+        lines.append(f"# TYPE {name} gauge")
+        for b in sorted(latest):
+            labels = (f'bucket="{b}",'
+                      f'algo="{_esc(latest[b].get("algo", "?"))}"')
+            lines.append(f"{name}{{{labels}}} "
+                         f"{int(latest[b].get('step', 0))}")
+    return "\n".join(lines) + ("\n" if lines else "")
+
+
+def write_textfile(entries: List[Dict[str, Any]], path: str) -> str:
+    """Atomic write (tmp -> rename) — the textfile collector must
+    never scrape a torn exposition."""
+    text = render_prometheus(entries)
+    d = os.path.dirname(os.path.abspath(path))
+    os.makedirs(d, exist_ok=True)
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        f.write(text)
+    os.replace(tmp, path)
+    return path

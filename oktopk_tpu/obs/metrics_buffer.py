@@ -1,0 +1,92 @@
+"""Device-side metric ring buffer for the in-jit quality taps.
+
+The signal-fidelity scalars (obs/quality.py) are computed inside the
+jitted train step; fetching them to host every step would add a device
+sync the steady-state loop never otherwise pays. Instead each bucket
+owns a :class:`QualityBuffer` — a fixed-capacity f32 ring living in
+``DistTrainState.quality`` — that the step pushes one row into per
+call. Only on the flush cadence (``obs_quality_every`` steps) does the
+trainer ``device_get`` the whole ring and drain the new rows into
+``quality`` journal events, so steady state adds ZERO extra host
+transfers (the acceptance property tests/test_quality.py pins).
+
+The cursor is MONOTONIC (total pushes, not a wrapped index): the host
+keeps its last-seen cursor and :func:`rows_since` reconstructs exactly
+the rows pushed since, in order, from ``cursor % capacity``. A ring
+sized to the flush cadence therefore never drops a row; an undersized
+ring degrades gracefully to the newest ``capacity`` rows.
+
+Rows are pushed UNCONDITIONALLY — guard-skipped steps included — so
+quality accounting stays consistent with the wire/step accounting that
+also advances on skips (optim/distributed.py guard block); the
+``skipped`` column marks those rows instead. Only the step-over-step
+baselines (``prev_res_norm``, ``prev_sig``) freeze across a skip,
+because the rolled-back residual/selection next step is compared
+against the last *committed* state, not the discarded one.
+"""
+
+from __future__ import annotations
+
+import flax.struct
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+# ring columns, in order (host-side names for the flush payload)
+COLUMNS = ("step", "comp_err", "res_norm", "res_growth", "eff_density",
+           "thr_drift", "churn", "skipped")
+NUM_COLS = len(COLUMNS)
+
+
+@flax.struct.dataclass
+class QualityBuffer:
+    """Per-bucket on-device fidelity ring + step-over-step baselines."""
+    ring: jnp.ndarray           # f32[capacity, NUM_COLS]
+    cursor: jnp.ndarray         # i32 — monotonic push count
+    prev_res_norm: jnp.ndarray  # f32 — last committed residual norm
+    prev_sig: jnp.ndarray       # f32[sig_bins] — last committed winner sig
+
+
+def init_buffer(capacity: int, sig_bins: int,
+                dtype=jnp.float32) -> QualityBuffer:
+    capacity = max(1, int(capacity))
+    return QualityBuffer(
+        ring=jnp.zeros((capacity, NUM_COLS), dtype),
+        cursor=jnp.asarray(0, jnp.int32),
+        prev_res_norm=jnp.asarray(0.0, dtype),
+        prev_sig=jnp.zeros((int(sig_bins),), dtype))
+
+
+def push_row(buf: QualityBuffer, row: jnp.ndarray, sig: jnp.ndarray,
+             res_norm: jnp.ndarray, skipped: jnp.ndarray) -> QualityBuffer:
+    """Append one row (traced, in-jit). ``skipped`` freezes the
+    baselines but never the ring — the row itself always lands."""
+    cap = buf.ring.shape[0]
+    idx = lax.rem(buf.cursor, jnp.asarray(cap, buf.cursor.dtype))
+    ring = lax.dynamic_update_slice(
+        buf.ring, row.astype(buf.ring.dtype)[None],
+        (idx, jnp.asarray(0, idx.dtype)))
+    keep = skipped.astype(bool)
+    return buf.replace(
+        ring=ring, cursor=buf.cursor + 1,
+        prev_res_norm=jnp.where(keep, buf.prev_res_norm,
+                                res_norm.astype(buf.prev_res_norm.dtype)),
+        prev_sig=jnp.where(keep, buf.prev_sig,
+                           sig.astype(buf.prev_sig.dtype)))
+
+
+def rows_since(ring: np.ndarray, cursor: int, prev_cursor: int) -> np.ndarray:
+    """Host-side drain: the rows pushed in ``(prev_cursor, cursor]``,
+    oldest first. ``ring`` may carry a leading worker axis ([P, cap, C]
+    off the sharded state) — worker rows are averaged, which is exact
+    for the replicated columns and the worker-mean for the per-worker
+    ones (residual norm, threshold drift)."""
+    ring = np.asarray(ring, np.float64)
+    if ring.ndim == 3:
+        ring = ring.mean(axis=0)
+    cap = ring.shape[0]
+    count = min(int(cursor) - int(prev_cursor), cap)
+    if count <= 0:
+        return np.zeros((0, ring.shape[1]), np.float64)
+    idx = [(int(cursor) - count + i) % cap for i in range(count)]
+    return ring[idx]

@@ -1,0 +1,166 @@
+"""In-jit compression-quality taps: per-bucket fidelity scalars.
+
+Ok-Topk's convergence argument (PAPER.md) rests on two quantities no
+byte or millisecond counter can see: the error-feedback residual
+staying bounded, and local selection actually approximating the global
+top-k. This module computes those — on device, inside the traced step,
+next to values the collectives already materialise — and stages them
+into the :mod:`obs.metrics_buffer` ring so steady state adds zero host
+syncs (the tap's only per-step cost is one dense ``pmean`` and a
+handful of reductions over buffers already in registers/VMEM).
+
+Per-bucket scalars (ring columns, obs/metrics_buffer.py COLUMNS):
+
+- ``comp_err``   — ``‖ĝ−g‖²/‖g‖²`` of the delivered reduced gradient
+  against the pre-selection dense gradient ``g = pmean(grad+residual)``
+  (the exact vector the selection approximates; dense-warmup steps
+  score ~0).
+- ``res_norm``   — ‖residual‖₂ after the step (per-worker; the flush
+  averages workers).
+- ``res_growth`` — step-over-step ratio vs the last *committed*
+  residual norm (guard-skipped steps don't advance the baseline).
+- ``eff_density``— realised k̂/n of the delivered vector (nonzero
+  count of ``reduced``), covering repair/overflow/fallback branches —
+  what actually reached the optimizer, not what the config asked for.
+- ``thr_drift``  — predicted local threshold vs the last exact
+  recompute's measured one (how far the threshold controller has
+  drifted off its calibration).
+- ``churn``      — 1 − overlap of this step's selected positions with
+  the last committed step's, via a hashed Bloom-style signature
+  (:func:`winner_signature`) so no index history is materialised.
+
+The same tap functions serve both the trainer's step
+(optim/distributed.py) and the standalone oracle harness
+(collectives/api.py ``build_quality_allreduce_step``), so the offline
+dense-vs-sparse oracle in tests/test_quality.py checks the exact code
+the trainer journals through.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Dict, List, Optional
+
+import jax.numpy as jnp
+
+from oktopk_tpu.obs.metrics_buffer import (COLUMNS, QualityBuffer,
+                                           init_buffer, push_row)
+
+_TINY = 1e-30
+
+# Knuth's multiplicative hash constant (2^32 / phi) — cheap, stateless,
+# and uniform enough for a presence signature over coordinate indices.
+_HASH_MULT = 2654435761
+
+
+@dataclasses.dataclass(frozen=True)
+class QualityConfig:
+    """Static tap configuration (trace-time constants).
+
+    ``every`` is both the flush cadence and the ring capacity, so a
+    flush always drains exactly the window since the last one.
+    ``sig_bins`` sizes the churn signature; power of two so the hash
+    reduces with a shift, and small enough (default 512) that the
+    per-step signature compare is noise next to the collective."""
+    every: int = 32
+    sig_bins: int = 512
+
+    def __post_init__(self):
+        if self.every < 1:
+            raise ValueError(f"every must be >= 1, got {self.every}")
+        b = int(self.sig_bins)
+        if b < 2 or (b & (b - 1)) != 0:
+            raise ValueError(
+                f"sig_bins must be a power of two >= 2, got {self.sig_bins}")
+
+
+def winner_signature(reduced: jnp.ndarray, sig_bins: int) -> jnp.ndarray:
+    """Bloom-style presence signature of the selected positions.
+
+    Hashes every coordinate index into ``sig_bins`` buckets and max-
+    scatters the selection mask, giving a fixed-size f32 vector whose
+    min/max overlap approximates index-set overlap — no sorted index
+    list, no step-over-step index history."""
+    n = reduced.shape[0]
+    shift = 32 - int(math.log2(sig_bins))
+    h = ((jnp.arange(n, dtype=jnp.uint32) * jnp.uint32(_HASH_MULT))
+         >> jnp.uint32(shift)).astype(jnp.int32)
+    mask = (reduced != 0).astype(jnp.float32)
+    return jnp.zeros((sig_bins,), jnp.float32).at[h].max(mask)
+
+
+def measure_bucket(reduced: jnp.ndarray, dense: jnp.ndarray, sp_new,
+                   prev_sig: jnp.ndarray,
+                   prev_res_norm: jnp.ndarray) -> Dict[str, jnp.ndarray]:
+    """All fidelity scalars for one bucket, one step (traced).
+
+    ``dense`` must be the pre-selection dense gradient — the pmean of
+    exactly what each worker handed the compressor plus its residual.
+    Returns a dict keyed like COLUMNS (minus step/skipped) plus the new
+    signature under ``"sig"``."""
+    n = reduced.shape[0]
+    reduced = reduced.astype(jnp.float32)
+    dense = dense.astype(jnp.float32)
+    comp_err = (jnp.sum((reduced - dense) ** 2)
+                / (jnp.sum(dense ** 2) + _TINY))
+    res_norm = jnp.sqrt(
+        jnp.sum(sp_new.residual.astype(jnp.float32) ** 2))
+    res_growth = jnp.where(prev_res_norm > 0,
+                           res_norm / jnp.maximum(prev_res_norm, _TINY),
+                           jnp.asarray(1.0, jnp.float32))
+    eff_density = (jnp.sum(reduced != 0).astype(jnp.float32)
+                   / jnp.asarray(n, jnp.float32))
+    lt = sp_new.local_threshold.astype(jnp.float32)
+    le = sp_new.last_exact_lt.astype(jnp.float32)
+    thr_drift = jnp.where(le > 0, lt / jnp.maximum(le, _TINY),
+                          jnp.asarray(1.0, jnp.float32))
+    sig = winner_signature(reduced, prev_sig.shape[0])
+    inter = jnp.sum(jnp.minimum(sig, prev_sig))
+    union = jnp.maximum(jnp.sum(jnp.maximum(sig, prev_sig)), 1.0)
+    churn = 1.0 - inter / union
+    return {"comp_err": comp_err, "res_norm": res_norm,
+            "res_growth": res_growth, "eff_density": eff_density,
+            "thr_drift": thr_drift, "churn": churn, "sig": sig}
+
+
+def commit(buf: QualityBuffer, step, scalars: Dict[str, jnp.ndarray],
+           skipped) -> QualityBuffer:
+    """Push one measured step into the ring (traced). ``step`` is the
+    bucket's SparseState counter post-bump; ``skipped`` the agreed
+    guard flag (freezes the baselines, never the push)."""
+    skipped = jnp.asarray(skipped)
+    row = jnp.stack([
+        jnp.asarray(step, jnp.float32),
+        scalars["comp_err"], scalars["res_norm"], scalars["res_growth"],
+        scalars["eff_density"], scalars["thr_drift"], scalars["churn"],
+        skipped.astype(jnp.float32)])
+    return push_row(buf, row, scalars["sig"], scalars["res_norm"], skipped)
+
+
+# ---- host-side flush helpers ---------------------------------------------
+
+def _sanitize(v: float) -> Optional[float]:
+    v = float(v)
+    return v if math.isfinite(v) else None
+
+
+def quality_event(step: int, bucket: int, algo: str,
+                  rows) -> Dict[str, Any]:
+    """A schema-conformant ``quality`` event payload from drained ring
+    rows (``metrics_buffer.rows_since`` output). Non-finite samples
+    become null — JSON has no NaN, and the rollup skips them."""
+    ev: Dict[str, Any] = {"step": int(step), "bucket": int(bucket),
+                          "algo": str(algo), "count": int(len(rows))}
+    cols: Dict[str, List[Any]] = {c: [] for c in COLUMNS}
+    for row in rows:
+        for c, v in zip(COLUMNS, row):
+            if c == "step":
+                cols[c].append(int(v))
+            elif c == "skipped":
+                cols[c].append(int(v > 0.5))
+            else:
+                cols[c].append(_sanitize(v))
+    ev["steps"] = cols.pop("step")
+    ev.update(cols)
+    return ev

@@ -8,10 +8,15 @@ step be on this container", so a live run can flag when its own step
 time drifts past ``tolerance ×`` that history and journal a
 ``regression`` event the report surfaces.
 
-The detector is advisory: it never throws, and with no baseline
-available (no records, or none carrying the key) it stays silent.
-A warmup window skips the first observations — compile time dominates
-them and would always "regress".
+The detector is advisory: it never throws. With no baseline available
+(no records, none carrying the key, or only malformed files) it makes
+no step-time judgements, but journals one ``baseline_warning`` event so
+the gap is visible in the report rather than silent. A warmup window
+skips the first observations — compile time dominates them and would
+always "regress". ``observe_quality`` additionally checks fidelity
+summary fields (from the quality telemetry plane, obs/quality.py)
+against configured limits, journalling ``regression`` events with
+``key="quality:<field>"``.
 """
 
 from __future__ import annotations
@@ -26,22 +31,44 @@ _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 
 
-def load_bench_values(key: str,
-                      root: Optional[str] = None) -> List[float]:
-    """All ``parsed[key]`` values from BENCH_r*.json under ``root``
-    (repo root by default). Tolerates missing/garbled records."""
+def scan_bench_records(key: str, root: Optional[str] = None):
+    """Scan BENCH_r*.json under ``root`` (repo root by default) for
+    ``key``. Returns ``(values, n_files, malformed)`` where ``malformed``
+    lists basenames of records that existed but could not be used
+    (unreadable JSON, or not a dict) — so callers can journal a
+    ``baseline_warning`` instead of silently training unbaselined.
+
+    The key is looked up in the record's ``parsed`` dict first, then at
+    the top level — quality summary keys (e.g. ``quality_comp_err``)
+    land wherever bench.py's ``_record`` copied them."""
     root = root or _REPO_ROOT
-    out: List[float] = []
-    for path in sorted(glob.glob(os.path.join(root, "BENCH_r*.json"))):
+    values: List[float] = []
+    malformed: List[str] = []
+    paths = sorted(glob.glob(os.path.join(root, "BENCH_r*.json")))
+    for path in paths:
         try:
             with open(path) as f:
                 rec = json.load(f)
-            val = (rec.get("parsed") or {}).get(key)
-            if isinstance(val, (int, float)):
-                out.append(float(val))
+            if not isinstance(rec, dict):
+                malformed.append(os.path.basename(path))
+                continue
+            parsed = rec.get("parsed")
+            val = (parsed or {}).get(key) if isinstance(parsed, dict) \
+                else None
+            if val is None:
+                val = rec.get(key)
+            if isinstance(val, (int, float)) and not isinstance(val, bool):
+                values.append(float(val))
         except Exception:
-            continue
-    return out
+            malformed.append(os.path.basename(path))
+    return values, len(paths), malformed
+
+
+def load_bench_values(key: str,
+                      root: Optional[str] = None) -> List[float]:
+    """All usable ``key`` values from BENCH_r*.json under ``root``
+    (repo root by default). Tolerates missing/garbled records."""
+    return scan_bench_records(key, root=root)[0]
 
 
 class RegressionDetector:
@@ -49,12 +76,14 @@ class RegressionDetector:
 
     def __init__(self, baseline_ms: Optional[float],
                  tolerance: float = 1.5, warmup_windows: int = 2,
-                 bus=None, key: Optional[str] = None):
+                 bus=None, key: Optional[str] = None,
+                 quality_limits: Optional[Dict[str, float]] = None):
         self.baseline_ms = baseline_ms
         self.tolerance = float(tolerance)
         self.warmup_windows = int(warmup_windows)
         self.bus = bus
         self.key = key
+        self.quality_limits = dict(quality_limits or {})
         self.observations = 0
         self.flagged: List[Dict[str, Any]] = []
 
@@ -62,9 +91,18 @@ class RegressionDetector:
     def from_bench_records(cls, key: str = "oktopk_ms",
                            root: Optional[str] = None,
                            **kwargs) -> "RegressionDetector":
-        vals = load_bench_values(key, root=root)
+        vals, n_files, malformed = scan_bench_records(key, root=root)
         baseline = statistics.median(vals) if vals else None
-        return cls(baseline, key=key, **kwargs)
+        det = cls(baseline, key=key, **kwargs)
+        if baseline is None and det.bus is not None:
+            # an unusable baseline must not kill training (the detector
+            # is advisory) — but it must not vanish silently either
+            reason = ("no BENCH records" if n_files == 0
+                      else f"no usable '{key}' value in {n_files} records")
+            det.bus.emit("baseline_warning", step=0, key=str(key),
+                         reason=reason, files=n_files,
+                         malformed=list(malformed))
+        return det
 
     def observe(self, step: int, ms: float) -> Optional[Dict[str, Any]]:
         """Feed one measured step time (milliseconds). Returns the
@@ -85,3 +123,29 @@ class RegressionDetector:
         if self.bus is not None:
             self.bus.emit("regression", **rec)
         return rec
+
+    def observe_quality(self, step: int,
+                        summary: Dict[str, Any]) -> List[Dict[str, Any]]:
+        """Check a quality summary (e.g. a rollup's fields) against the
+        configured ``quality_limits`` (``{"comp_err_mean": 0.5, ...}``).
+        Each exceeded limit is journalled as a ``regression`` event with
+        ``key="quality:<field>"`` — the same event the feedback window
+        votes on, so fidelity drift can force a re-tune exactly like a
+        step-time regression. No warmup gating: quality values are not
+        compile-time-polluted."""
+        flagged: List[Dict[str, Any]] = []
+        for field, limit in self.quality_limits.items():
+            val = summary.get(field)
+            if not isinstance(val, (int, float)) or limit <= 0:
+                continue
+            val = float(val)
+            if val != val or val <= float(limit):   # NaN or within limit
+                continue
+            rec = {"step": int(step), "ms": val,
+                   "baseline_ms": float(limit), "ratio": val / float(limit),
+                   "tolerance": 1.0, "key": f"quality:{field}"}
+            flagged.append(rec)
+            self.flagged.append(rec)
+            if self.bus is not None:
+                self.bus.emit("regression", **rec)
+        return flagged

@@ -1,7 +1,8 @@
 """Anomaly-triggered profiler windows + Chrome trace export.
 
 ``AnomalyTracer`` subscribes to the run-journal event bus: a
-``guard_trip`` or ``fallback`` event ARMS it, and the next
+``guard_trip``, ``fallback``, or breach-flagged ``quality_rollup``
+event ARMS it, and the next
 ``on_step()`` call opens a bounded ``jax.profiler`` trace window over
 the following N steps, closing with a ``trace_captured`` journal event
 that ties the capture back to its trigger (``"guard_trip@step12"``).
@@ -25,7 +26,7 @@ import json
 import os
 from typing import Any, Dict, List, Optional
 
-_TRIGGERS = ("guard_trip", "fallback")
+_TRIGGERS = ("guard_trip", "fallback", "quality_rollup")
 
 
 class AnomalyTracer:
@@ -53,6 +54,8 @@ class AnomalyTracer:
         event = entry.get("event")
         if event not in _TRIGGERS:
             return
+        if event == "quality_rollup" and not entry.get("breaches"):
+            return                 # only breached rollups are anomalies
         if self.active or self._armed is not None:
             return                 # one window at a time
         if len(self.captures) >= self.max_captures:

@@ -40,13 +40,18 @@ class DistTrainState:
     flat momentum buffer used only under momentum correction.
     ``health`` is the replicated :class:`resilience.guard.HealthState`
     (attempt/skip counters), present only when the step carries the
-    anomaly guard or a fault plan."""
+    anomaly guard or a fault plan. ``quality`` is the per-worker
+    :class:`obs.metrics_buffer.QualityBuffer` fidelity ring (per-bucket
+    tuple when bucketed, mirroring ``sparse_state``), present only when
+    the step carries the in-jit quality taps; checkpoints saved before
+    the field existed restore cleanly (checkpoint.py template merge)."""
     params: Any
     model_state: Any          # e.g. flax batch_stats collection
     opt_state: Any
     sparse_state: SparseState
     local_momentum: Any = None
     health: Any = None
+    quality: Any = None
 
 
 def flat_size(params) -> int:
@@ -98,7 +103,8 @@ def init_dist_state(params, model_state, optimizer, cfg: OkTopkConfig,
                     momentum_correction: bool = False,
                     opt_state: Any = None,
                     num_buckets: int = 1,
-                    with_health: bool = False) -> DistTrainState:
+                    with_health: bool = False,
+                    quality=None) -> DistTrainState:
     """``momentum_correction`` must be truthy iff the step builder gets a
     nonzero ``momentum_correction`` factor — the shard_map specs key off the
     presence of ``local_momentum``. Pass ``opt_state`` to carry over existing
@@ -106,21 +112,33 @@ def init_dist_state(params, model_state, optimizer, cfg: OkTopkConfig,
     fresh one. With ``num_buckets > 1`` the sparse state (and momentum) is a
     tuple of per-bucket states matching :func:`bucket_partition`.
     ``with_health`` must be truthy iff the step builder gets a guard or a
-    fault plan — the shard_map specs key off the presence of ``health``."""
+    fault plan — the shard_map specs key off the presence of ``health``.
+    ``quality`` (an ``obs.quality.QualityConfig``) must likewise match the
+    step builder's ``quality`` argument: it allocates the per-bucket
+    fidelity rings the in-jit taps push into."""
     def batched(n_b):
         s = init_state(cfg.replace(n=n_b), dtype)
         return jax.tree.map(
             lambda x: jnp.broadcast_to(x, (cfg.num_workers,) + x.shape), s)
+
+    def qbatched():
+        from oktopk_tpu.obs.metrics_buffer import init_buffer
+        b = init_buffer(quality.every, quality.sig_bins, dtype)
+        return jax.tree.map(
+            lambda x: jnp.broadcast_to(x, (cfg.num_workers,) + x.shape), b)
 
     if num_buckets > 1:
         nbs = bucket_sizes(params, bucket_partition(params, num_buckets))
         s = tuple(batched(n_b) for n_b in nbs)
         mom = (tuple(jnp.zeros((cfg.num_workers, n_b), dtype)
                      for n_b in nbs) if momentum_correction else None)
+        qual = (tuple(qbatched() for _ in nbs)
+                if quality is not None else None)
     else:
         s = batched(cfg.n)
         mom = (jnp.zeros((cfg.num_workers, cfg.n), dtype)
                if momentum_correction else None)
+        qual = qbatched() if quality is not None else None
     health = None
     if with_health:
         from oktopk_tpu.resilience.guard import init_health
@@ -129,7 +147,7 @@ def init_dist_state(params, model_state, optimizer, cfg: OkTopkConfig,
                           opt_state=(optimizer.init(params)
                                      if opt_state is None else opt_state),
                           sparse_state=s, local_momentum=mom,
-                          health=health)
+                          health=health, quality=qual)
 
 
 def build_sparse_grad_step(
@@ -148,6 +166,7 @@ def build_sparse_grad_step(
     bucket_densities: Optional[Sequence[float]] = None,
     guard=None,
     fault_plan=None,
+    quality=None,
 ):
     """Build the jitted distributed train step.
 
@@ -193,6 +212,16 @@ def build_sparse_grad_step(
         plan's deterministic NaN/Inf gradient injection into the traced
         step (wire-payload faults install separately via
         ``collectives.wire.install_wire_fault``). Chaos drills only.
+      quality: optional ``obs.quality.QualityConfig`` — adds the in-jit
+        signal-fidelity taps: per-bucket compression error vs the
+        pre-selection dense gradient, residual norm/growth, realised
+        density, threshold drift and winner-index churn, pushed into the
+        device-side ring in ``state.quality`` every step (guard-skipped
+        steps included, flagged). Purely read-only on the training
+        computation — the trajectory is bit-identical taps-on vs
+        taps-off — and host-sync-free: the ring is drained only when the
+        trainer flushes it (docs/OBSERVABILITY.md "Signal fidelity").
+        Requires ``state.quality`` (``init_dist_state(quality=...)``).
 
     Returns ``step(state: DistTrainState, batch, rng) -> (state, metrics)``.
     ``batch`` leaves are [num_workers * nsteps_update * mb, ...] and get
@@ -215,12 +244,19 @@ def build_sparse_grad_step(
     if has_health:
         from oktopk_tpu.resilience import faults as _faults  # noqa: F401
         from oktopk_tpu.resilience import guard as _guard_mod
+    has_quality = quality is not None
+    if has_quality:
+        from oktopk_tpu.obs import quality as _quality_mod
 
     def shard_fn(state: DistTrainState, batch, rng):
         if has_health and state.health is None:
             raise ValueError(
                 "guard/fault_plan need state.health: build the state with "
                 "init_dist_state(with_health=True)")
+        if has_quality and state.quality is None:
+            raise ValueError(
+                "quality taps need state.quality: build the state with "
+                "init_dist_state(quality=...)")
         rng = jax.random.fold_in(rng, lax.axis_index(axis_name))
 
         # --- local grads, with optional microbatch accumulation ---
@@ -267,9 +303,11 @@ def build_sparse_grad_step(
         moms_in = (([state.local_momentum] if single
                     else list(state.local_momentum))
                    if momentum_correction else None)
+        quals_in = (([state.quality] if single else list(state.quality))
+                    if has_quality else None)
         results = [None] * len(leaves)
         sp_olds, sp_news, new_moms, bad_counts = [], [], [], []
-        absmaxes = []
+        absmaxes, qual_taps = [], []
         vol = lk = gk = wbytes = jnp.asarray(0.0, jnp.float32)
         eps_num = eps_den = jnp.asarray(0.0, jnp.float32)
         for bi, idxs in enumerate(buckets):
@@ -300,6 +338,18 @@ def build_sparse_grad_step(
                 flat = momentum_correction * moms_in[bi][0] + flat
                 new_moms.append(flat[None])
             reduced, sp_new = algos[bi](flat, sp, cfg_b, axis_name)
+            if has_quality:
+                # fidelity tap (obs/quality.py): reference is the dense
+                # gradient the selection approximated — exactly what this
+                # worker handed the compressor (faults and momentum fold
+                # included) plus its residual, pmean'd. Measured here
+                # (pre-guard, observed values); committed into the ring
+                # after the guard agrees on the skip flag.
+                qb = jax.tree.map(lambda x: x[0], quals_in[bi])
+                dense_q = lax.pmean(flat + sp.residual, axis_name)
+                qual_taps.append((qb, _quality_mod.measure_bucket(
+                    reduced, dense_q, sp_new, qb.prev_sig,
+                    qb.prev_res_norm)))
             if guard is not None:
                 bad_counts.append(
                     _guard_mod.local_anomaly_count(flat, reduced, guard))
@@ -400,20 +450,40 @@ def build_sparse_grad_step(
                 health, jnp.asarray(False),
                 jnp.zeros_like(health.bucket_trips))
 
+        quality_out = state.quality
+        if has_quality:
+            # commit the taps AFTER the guard: the ring row always lands
+            # (quality accounting advances on skips, exactly like the
+            # wire accounting above) with the skip flag recorded, while
+            # the step-over-step baselines freeze on skipped steps —
+            # next step compares against the last COMMITTED state, which
+            # is what the rollback restored
+            skip = (any_bad if guard is not None
+                    else jnp.asarray(False))
+            new_quals = [
+                jax.tree.map(
+                    lambda x: x[None],
+                    _quality_mod.commit(qb, sp_news[bi].step, scalars,
+                                        skip))
+                for bi, (qb, scalars) in enumerate(qual_taps)]
+            quality_out = new_quals[0] if single else tuple(new_quals)
+
         new_sparse = [jax.tree.map(lambda x: x[None], s) for s in sp_news]
         sparse_out = new_sparse[0] if single else tuple(new_sparse)
         new_state = DistTrainState(
             params=params, model_state=model_state, opt_state=opt_state,
             sparse_state=sparse_out,
             local_momentum=new_momentum,
-            health=health)
+            health=health,
+            quality=quality_out)
         return new_state, metrics
 
     state_specs = DistTrainState(
         params=P(), model_state=P(), opt_state=P(),
         sparse_state=P(axis_name),
         local_momentum=P(axis_name) if momentum_correction else None,
-        health=P() if has_health else None)
+        health=P() if has_health else None,
+        quality=P(axis_name) if has_quality else None)
     mapped = compat.shard_map(
         shard_fn, mesh=mesh,
         in_specs=(state_specs, P(axis_name), P()),

@@ -58,6 +58,7 @@ class DensityBackoff:
         self.level = 0
         self._near = 0
         self._clean = 0
+        self._fidelity = 0  # consecutive quality-breach signals
 
     @property
     def scale(self) -> float:
@@ -88,4 +89,28 @@ class DensityBackoff:
                 self._clean = 0
                 return {"direction": "advance", "level": self.level,
                         "scale": self.scale, "trigger": "clean_streak"}
+        return None
+
+    def note_quality_breach(self, step: int,
+                            kind: str) -> Optional[Dict[str, Any]]:
+        """Digest one fidelity breach from a ``quality_rollup`` — the
+        other half of the closed loop. Guard pressure pushes the level
+        DOWN (less density); sustained residual-growth / compression-
+        error breaches mean the compressed stream is no longer carrying
+        the gradient, so after ``backoff_steps`` such signals the level
+        advances back UP one notch (more density). Breach kinds that
+        argue for LESS density (``churn_spike``, ``density_collapse``)
+        are deliberately not counted here: churn is a selection-
+        stability symptom and collapse is a downstream effect of this
+        very controller. Same journal-ready return contract as
+        :meth:`observe`."""
+        if kind not in ("residual_growth", "comp_err"):
+            return None
+        self._fidelity += 1
+        if self._fidelity >= self.backoff_steps and self.level > 0:
+            self.level -= 1
+            self._fidelity = 0
+            self._clean = 0
+            return {"direction": "advance", "level": self.level,
+                    "scale": self.scale, "trigger": "quality_breach"}
         return None

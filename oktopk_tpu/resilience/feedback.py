@@ -57,6 +57,9 @@ class AutotuneFeedback:
     def _on_event(self, entry: Dict[str, Any]) -> None:
         if entry.get("event") not in self.kinds:
             return
+        if (entry.get("event") == "quality_rollup"
+                and not entry.get("breaches")):
+            return       # clean fidelity windows are not degradation
         step = entry.get("step")
         if isinstance(step, (int, float)):
             self.signals.append((int(step), str(entry["event"])))

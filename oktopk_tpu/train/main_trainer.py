@@ -117,6 +117,16 @@ def parse_args(argv=None):
     p.add_argument("--obs-regress-key", default=None,
                    help="BENCH_r*.json parsed key (e.g. oktopk_ms) to "
                         "baseline step-time regression checks against")
+    p.add_argument("--obs-quality", action="store_true",
+                   help="in-jit signal-fidelity taps (obs/quality.py): "
+                        "per-bucket compression error, residual growth, "
+                        "effective density, threshold drift and index "
+                        "churn accumulated in device-side rings and "
+                        "journalled every --obs-quality-every steps")
+    p.add_argument("--obs-quality-every", type=int, default=32,
+                   help="quality ring capacity / host-flush cadence "
+                        "(steps); between flushes the taps add zero "
+                        "host syncs")
     p.add_argument("--density", type=float, default=0.02)
     p.add_argument("--sigma-scale", type=float, default=2.5)
     p.add_argument("--grad-clip", type=float, default=None)
@@ -220,7 +230,9 @@ def main(argv=None):
         obs=args.obs,
         obs_trace_on_anomaly=args.obs_trace_on_anomaly,
         obs_trace_steps=args.obs_trace_steps,
-        obs_regress_key=args.obs_regress_key)
+        obs_regress_key=args.obs_regress_key,
+        obs_quality=args.obs_quality,
+        obs_quality_every=args.obs_quality_every)
     slug = cfg.experiment_slug()
     # Observability and checkpoints are rank-0 work (the reference gates its
     # writer/checkpointer the same way, VGG/dl_trainer.py:614-616) — on a

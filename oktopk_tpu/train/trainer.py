@@ -125,10 +125,30 @@ class Trainer:
         self.run_journal = None
         self.tracer = None
         self.regress = None
+        self.rollup = None
+        self._quality_cfg = None
+        self.quality_flushes = 0   # host drains of the device rings
+        self._q_cursors = {}       # bucket -> last drained ring cursor
         if cfg.obs:
             from oktopk_tpu.obs.journal import EventBus, RunJournal
             self.bus = EventBus()
             self.run_journal = RunJournal(cfg.obs_journal, bus=self.bus)
+            if cfg.obs_quality:
+                # journal first, rollup engine second: the engine's
+                # nested emit then lands each quality_rollup directly
+                # after its quality event in the file
+                from oktopk_tpu.obs.quality import QualityConfig
+                from oktopk_tpu.obs.rollup import RollupEngine
+                self._quality_cfg = QualityConfig(
+                    every=cfg.obs_quality_every,
+                    sig_bins=cfg.obs_quality_sig_bins)
+                self.rollup = RollupEngine(
+                    self.bus,
+                    growth_limit=cfg.obs_quality_growth_limit,
+                    collapse_ratio=cfg.obs_quality_collapse_ratio,
+                    churn_limit=cfg.obs_quality_churn_limit,
+                    comp_err_limit=cfg.obs_quality_comp_err_limit,
+                    on_breach=self._on_quality_breach)
             if cfg.obs_trace_on_anomaly:
                 import os
                 import tempfile
@@ -174,10 +194,16 @@ class Trainer:
         self.feedback = None
         if cfg.resilience_feedback and self.bus is not None:
             from oktopk_tpu.resilience import AutotuneFeedback
+            kinds = ("regression", "guard_trip")
+            if self._quality_cfg is not None:
+                # breached quality rollups vote alongside guard trips and
+                # perf regressions in the forced-retune window
+                kinds = kinds + ("quality_rollup",)
             self.feedback = AutotuneFeedback(
                 self.bus, window_steps=cfg.resilience_feedback_window,
                 min_signals=cfg.resilience_feedback_signals,
-                cooldown_steps=cfg.resilience_feedback_cooldown)
+                cooldown_steps=cfg.resilience_feedback_cooldown,
+                kinds=kinds)
         self.density_backoff = None
         if cfg.resilience and cfg.resilience_density_backoff:
             from oktopk_tpu.resilience import DensityBackoff
@@ -196,7 +222,8 @@ class Trainer:
             params, self.model_state, self.optimizer, self.algo_cfg,
             momentum_correction=bool(self._mc_factor),
             num_buckets=cfg.num_buckets,
-            with_health=self._with_health)
+            with_health=self._with_health,
+            quality=self._quality_cfg)
         self.autotuner = None      # built lazily by autotune()
         self._plans = None         # per-bucket BucketPlan list, or None
         self.step_fn = self._build_step()
@@ -249,7 +276,60 @@ class Trainer:
             momentum_correction=self._mc_factor,
             num_buckets=self.cfg.num_buckets,
             bucket_densities=densities,
-            guard=self._guard, fault_plan=self._fault_plan)
+            guard=self._guard, fault_plan=self._fault_plan,
+            quality=self._quality_cfg)
+
+    # ---- signal-fidelity telemetry (obs/quality.py) -------------------
+
+    def _flush_quality(self, step: int) -> None:
+        """Drain the device-side quality rings to the journal — the ONLY
+        device→host movement the telemetry plane performs. One
+        ``jax.device_get`` of the ring leaves per flush; each bucket's
+        new rows become a schema-versioned ``quality`` event, which the
+        RollupEngine immediately aggregates into a ``quality_rollup``."""
+        if self._quality_cfg is None or self.bus is None:
+            return
+        if self.state.quality is None:
+            return
+        from oktopk_tpu.obs.metrics_buffer import rows_since
+        from oktopk_tpu.obs.quality import quality_event
+        names, densities = self._bucket_plan()
+        if self.rollup is not None:
+            self.rollup.target_densities = [float(d) for d in densities]
+        single = self.cfg.num_buckets <= 1
+        bufs = ([self.state.quality] if single
+                else list(self.state.quality))
+        host = jax.device_get(bufs)
+        for b, hb in enumerate(host):
+            cursor = int(np.asarray(hb.cursor).reshape(-1)[0])
+            prev = self._q_cursors.get(b, 0)
+            if cursor == prev:
+                continue
+            rows = rows_since(np.asarray(hb.ring), cursor, prev)
+            self._q_cursors[b] = cursor
+            algo = names[b] if b < len(names) else self.cfg.compressor
+            ev = quality_event(step, b, algo, rows)
+            self.bus.emit("quality", **ev)
+        self.quality_flushes += 1
+
+    def _on_quality_breach(self, step: int, bucket: int, breaches) -> None:
+        """RollupEngine breach hook: route sustained FIDELITY breaches to
+        the density-backoff controller. Guard pressure pushes density
+        down; compression-quality pressure pulls it back up — the two
+        halves of the closed loop meet in the same hysteretic policy."""
+        if self.density_backoff is None:
+            return
+        change = None
+        for kind in breaches:
+            change = self.density_backoff.note_quality_breach(
+                int(step), str(kind)) or change
+        if change is not None:
+            self._density_scale = float(change["scale"])
+            if self.supervisor is not None:
+                self.supervisor.journal.density_backoff(int(step), **change)
+            elif self.bus is not None:
+                self.bus.emit("density_backoff", step=int(step), **change)
+            self.step_fn = self._build_step()
 
     # ---- autotuning ---------------------------------------------------
 
@@ -614,6 +694,11 @@ class Trainer:
                 # check cadence; escalation may rebuild step_fn or
                 # restore state before the next iteration
                 self.supervise(step, metrics)
+            if (self._quality_cfg is not None
+                    and step % self._quality_cfg.every == 0):
+                # drain the device metric rings on the flush cadence —
+                # steady state between flushes adds zero host syncs
+                self._flush_quality(step)
             if self.feedback is not None:
                 # fault→autotune feedback: a passing window vote forces
                 # a re-calibrate + re-tune (host-side list ops only
@@ -655,6 +740,9 @@ class Trainer:
             flush_pending()
         if self.tracer is not None:
             self.tracer.finish(self.last_step)
+        if self._quality_cfg is not None:
+            # partial-window flush so the tail of the run is journalled
+            self._flush_quality(self.last_step)
         if self.bus is not None:
             self._emit_volume_report()
         self.metrics_history.append(
@@ -740,9 +828,16 @@ class Trainer:
             old[0], old[1], self.optimizer, self.algo_cfg,
             momentum_correction=bool(self._mc_factor), opt_state=old[2],
             num_buckets=self.cfg.num_buckets,
-            with_health=self._with_health)
+            with_health=self._with_health,
+            quality=self._quality_cfg)
         carried = ["params", "model_state", "opt_state"]
         reinit = ["sparse_state", "local_momentum", "autotuner"]
+        if self._quality_cfg is not None:
+            # fresh per-worker rings for the new topology; drained-cursor
+            # bookkeeping restarts with them so the first post-resize
+            # flush doesn't replay stale rows
+            self._q_cursors = {}
+            reinit.append("quality")
         if old_health is not None and self.state.health is not None:
             # the attempted-step counter is the clock every fault plan
             # and supervisor cadence indexes by — it must stay monotonic

@@ -3,18 +3,28 @@
 
 Usage:
     python scripts/obs_report.py logs/<slug>/run_journal.jsonl
+    python scripts/obs_report.py run_journal.jsonl --strict   # CI gate
+    python scripts/obs_report.py run_journal.jsonl --json
+    python scripts/obs_report.py run_journal.jsonl --prom quality.prom
 
 Sections (each omitted when the journal has no matching events):
 
 - environment header (jax/jaxlib/device/world, schema version)
 - step metrics summary (first/last loss, mean wire bytes, skips)
 - per-bucket volume-vs-budget table with conformance ratios
+- signal-fidelity table: latest quality rollup per bucket (compression
+  error, residual growth, effective density, churn) + breach counts
 - autotune decision log (per-bucket chosen algorithm + reason)
 - host phase table (latest ``phase`` event)
 - incident timeline: faults, guard trips, fallbacks, restores,
   checkpoints (including durable-plane saves, verification failures and
   verified restores), trace captures, regressions, remeshes, forced
-  re-tunes and density backoffs in step order
+  re-tunes, density backoffs, baseline warnings and breach-flagged
+  quality rollups in step order
+
+Exit codes (``ckpt_fsck.py`` discipline): 0 clean; with ``--strict``,
+1 on schema violations or breach-flagged quality rollups; 2 when the
+journal cannot be read at all.
 
 Works on any JSONL journal that validates against
 ``oktopk_tpu.obs.events`` (see docs/OBSERVABILITY.md).
@@ -30,11 +40,13 @@ from typing import Any, Dict, List
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 # events rendered on the incident timeline, in journal order
+# (quality_rollup rows appear only when breach-flagged)
 _INCIDENT_EVENTS = ("fault_seen", "guard_trip", "fallback", "restore",
                     "restore_unavailable", "checkpoint",
                     "ckpt_saved", "ckpt_verify_failed", "ckpt_restore",
                     "trace_captured", "regression", "remesh", "retune",
-                    "density_backoff")
+                    "density_backoff", "baseline_warning",
+                    "quality_rollup")
 
 
 def _fmt_bytes(b: float) -> str:
@@ -99,6 +111,48 @@ def _volume_lines(entries: List[Dict[str, Any]]) -> List[str]:
     return out
 
 
+def _fmt_q(v: Any, spec: str = "9.4f") -> str:
+    if isinstance(v, (int, float)):
+        return format(float(v), spec)
+    head = spec.split(".")[0]
+    width = int(head) if head.isdigit() else 1
+    return format("?", f">{width}")
+
+
+def _quality_lines(entries: List[Dict[str, Any]]) -> List[str]:
+    rollups = [e for e in entries if e.get("event") == "quality_rollup"]
+    if not rollups:
+        return []
+    raw = sum(1 for e in entries if e.get("event") == "quality")
+    latest: Dict[int, Dict[str, Any]] = {}
+    breaches: Dict[int, int] = {}
+    for r in rollups:
+        b = int(r.get("bucket", 0))
+        latest[b] = r
+        breaches[b] = breaches.get(b, 0) + len(r.get("breaches") or [])
+    out = [f"signal fidelity ({raw} flushes, {len(rollups)} rollups; "
+           "latest window per bucket):",
+           f"  {'bucket':>6} {'algo':<10} {'comp_err':>9} {'res_grow':>9} "
+           f"{'density':>9} {'churn':>9} {'breaches':>8}"]
+    for b in sorted(latest):
+        r = latest[b]
+        out.append(
+            f"  {b:>6} {str(r.get('algo', '?')):<10} "
+            f"{_fmt_q(r.get('comp_err_mean'))} "
+            f"{_fmt_q(r.get('res_growth_mean'))} "
+            f"{_fmt_q(r.get('eff_density_mean'))} "
+            f"{_fmt_q(r.get('churn_mean'))} "
+            f"{breaches.get(b, 0):>8d}")
+    kinds: Dict[str, int] = {}
+    for r in rollups:
+        for k in (r.get("breaches") or []):
+            kinds[str(k)] = kinds.get(str(k), 0) + 1
+    if kinds:
+        out.append("  breach kinds: " + ", ".join(
+            f"{k} x{v}" for k, v in sorted(kinds.items())))
+    return out
+
+
 def _autotune_lines(entries: List[Dict[str, Any]]) -> List[str]:
     # both names: "autotune_decision" on the unified bus, "decision" in
     # a standalone DecisionJournal file fed to this report directly
@@ -131,7 +185,8 @@ def _phase_lines(entries: List[Dict[str, Any]]) -> List[str]:
 
 
 def _timeline_lines(entries: List[Dict[str, Any]]) -> List[str]:
-    inc = [e for e in entries if e.get("event") in _INCIDENT_EVENTS]
+    inc = [e for e in entries if e.get("event") in _INCIDENT_EVENTS
+           and (e["event"] != "quality_rollup" or e.get("breaches"))]
     if not inc:
         return []
     out = ["incident timeline:"]
@@ -180,6 +235,14 @@ def _timeline_lines(entries: List[Dict[str, Any]]) -> List[str]:
             detail = (f"{e.get('direction')} to level {e.get('level')} "
                       f"(x{e.get('scale', 1):.3f} density) "
                       f"[{e.get('trigger', '')}]")
+        elif ev == "baseline_warning":
+            detail = (f"{e.get('key')}: {e.get('reason')} "
+                      f"(files={e.get('files', 0)})")
+        elif ev == "quality_rollup":
+            detail = (f"bucket {e.get('bucket')} BREACH "
+                      f"{','.join(str(b) for b in e.get('breaches', []))} "
+                      f"(comp_err {_fmt_q(e.get('comp_err_mean'), '.4g')}, "
+                      f"density {_fmt_q(e.get('eff_density_mean'), '.4g')})")
         else:  # regression
             detail = (f"{e.get('ms', 0):.1f}ms vs baseline "
                       f"{e.get('baseline_ms', 0):.1f}ms "
@@ -193,8 +256,9 @@ def render_report(entries: List[Dict[str, Any]]) -> str:
     from oktopk_tpu.obs.events import validate_journal
 
     sections = [_header_lines(entries), _steps_lines(entries),
-                _volume_lines(entries), _autotune_lines(entries),
-                _phase_lines(entries), _timeline_lines(entries)]
+                _volume_lines(entries), _quality_lines(entries),
+                _autotune_lines(entries), _phase_lines(entries),
+                _timeline_lines(entries)]
     lines: List[str] = ["== run journal report =="]
     for sec in sections:
         if sec:
@@ -209,15 +273,66 @@ def render_report(entries: List[Dict[str, Any]]) -> str:
     return "\n".join(lines)
 
 
+def report_json(entries: List[Dict[str, Any]]) -> Dict[str, Any]:
+    """Machine-readable counterpart of :func:`render_report`."""
+    from oktopk_tpu.obs.events import validate_journal
+
+    counts: Dict[str, int] = {}
+    for e in entries:
+        ev = str(e.get("event", "?"))
+        counts[ev] = counts.get(ev, 0) + 1
+    rollups = [e for e in entries if e.get("event") == "quality_rollup"]
+    breached = [e for e in rollups if e.get("breaches")]
+    problems = validate_journal(entries)
+    return {
+        "entries": len(entries),
+        "events": counts,
+        "schema_problems": list(problems),
+        "quality": {
+            "rollups": len(rollups),
+            "breached_rollups": len(breached),
+            "breaches": [{"step": e.get("step"),
+                          "bucket": e.get("bucket"),
+                          "kinds": list(e.get("breaches") or [])}
+                         for e in breached],
+        },
+    }
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("journal", help="run_journal.jsonl path")
+    ap.add_argument("--strict", action="store_true",
+                    help="exit 1 on schema violations or breach-flagged "
+                         "quality rollups (CI gate)")
+    ap.add_argument("--json", action="store_true",
+                    help="print a machine-readable JSON summary instead "
+                         "of the human report")
+    ap.add_argument("--prom", default=None, metavar="PATH",
+                    help="also write a Prometheus textfile exposition of "
+                         "the quality rollups to PATH")
     args = ap.parse_args(argv)
 
     from oktopk_tpu.autotune.journal import read_journal
 
-    entries = read_journal(args.journal)
-    print(render_report(entries))
+    try:
+        entries = read_journal(args.journal)
+    except (OSError, ValueError) as e:
+        print(f"cannot read journal: {e}", file=sys.stderr)
+        return 2
+
+    summary = report_json(entries)
+    if args.json:
+        import json
+        print(json.dumps(summary, indent=2, sort_keys=True))
+    else:
+        print(render_report(entries))
+    if args.prom:
+        from oktopk_tpu.obs.export import write_textfile
+        write_textfile(entries, args.prom)
+    if args.strict and (summary["schema_problems"]
+                        or summary["quality"]["breached_rollups"]):
+        return 1
     return 0
 
 

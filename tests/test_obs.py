@@ -49,13 +49,15 @@ ALGOS = ["dense", "topkA", "topkA2", "topkAopt", "gtopk", "gaussiank",
 _WIRE_CACHE = {}
 
 
-def _measure_wire_bytes(name, cfg, mesh, rng, steps=9):
+def _measure_wire_bytes(name, cfg, mesh, rng, steps=9, key=None):
     """Per-step mean realised wire bytes (averaged over workers) in
     steady state: oktopk's every-4th-step exact recomputes draw from the
     larger cap_exact pool and are excluded, exactly like bench.py's
-    volume probe."""
-    if name in _WIRE_CACHE:
-        return _WIRE_CACHE[name]
+    volume probe. ``key`` disambiguates cache entries for non-default
+    configs (e.g. a different threshold_method)."""
+    key = key or name
+    if key in _WIRE_CACHE:
+        return _WIRE_CACHE[key]
     step = build_allreduce_step(name, cfg, mesh, warmup=False)
     state = batched_init_state(cfg)
     base = rng.randn(cfg.num_workers, cfg.n).astype(np.float32)
@@ -66,8 +68,8 @@ def _measure_wire_bytes(name, cfg, mesh, rng, steps=9):
         _, state = step(grads, state)
         if name != "oktopk" or i % cfg.global_recompute_every != 0:
             wires.append(float(np.asarray(state.last_wire_bytes).mean()))
-    _WIRE_CACHE[name] = sum(wires) / len(wires)
-    return _WIRE_CACHE[name]
+    _WIRE_CACHE[key] = sum(wires) / len(wires)
+    return _WIRE_CACHE[key]
 
 
 class TestWireConformance:
@@ -123,6 +125,24 @@ class TestWireConformance:
         mean_wire = _measure_wire_bytes("dense", cfg, mesh8, rng)
         assert mean_wire == pytest.approx(8.0 * self.N)
 
+    def test_hist_threshold_bounded_overshoot(self, mesh8, rng):
+        """The one-pass histogram threshold estimator trades threshold
+        exactness for the single scan, so it may select past k — its
+        wire contract is the capacity ceiling the fixed buffers enforce,
+        plus a bounded overshoot of the sort path's O(6k) budget (the
+        realised factor is ~1.45x; 2x is the regression tripwire)."""
+        cfg = self._cfg().replace(threshold_method="hist")
+        mean_wire = _measure_wire_bytes("oktopk", cfg, mesh8, rng,
+                                        key="oktopk:hist")
+        assert mean_wire > 0
+        assert mean_wire <= obs_volume.capacity_bytes("oktopk", cfg), (
+            f"oktopk[hist]: measured {mean_wire:.0f} B/step exceeds the "
+            "fixed-buffer capacity ceiling")
+        ratio = obs_volume.conformance_ratio("oktopk", cfg, mean_wire)
+        assert ratio <= 2.0, (
+            f"oktopk[hist]: overshoot ratio {ratio:.3f} vs the sort "
+            "path's budget — histogram threshold quality regressed")
+
 
 def _load_obs_report():
     path = os.path.join(os.path.dirname(os.path.dirname(
@@ -163,6 +183,7 @@ class TestRunJournalIntegration:
                 resilience=True, resilience_cooldown=0,
                 autotune=True,
                 obs=True, obs_journal=journal_path,
+                obs_quality=True, obs_quality_every=8,
                 obs_trace_on_anomaly=True, obs_trace_steps=2,
                 obs_trace_dir=str(tmp_path / "traces"),
                 obs_regress_key="oktopk_ms")
@@ -219,13 +240,68 @@ class TestRunJournalIntegration:
         assert rep["budget_bytes"] > 0
         assert rep["mean_wire_bytes"] > 0
 
+        # the signal-fidelity plane journalled alongside: per-window
+        # quality flushes, each immediately rolled up, faulted run
+        # included — and the whole journal is still schema-clean
+        quality = [e for e in entries if e["event"] == "quality"]
+        rollups = [e for e in entries if e["event"] == "quality_rollup"]
+        assert quality and len(rollups) == len(quality)
+        assert sum(e["count"] for e in quality) == self.STEPS
+        assert all(e["algo"] == "oktopk" for e in quality)
+
         # the report CLI renders this exact journal
         mod = _load_obs_report()
         text = mod.render_report(entries)
         assert "run journal report" in text
         assert "incident timeline" in text
         assert "volume conformance" in text
+        assert "signal fidelity" in text
         assert "schema: OK" in text
+
+    def test_sa_split_skips_keep_wire_and_quality_consistent(
+            self, mesh4, tmp_path):
+        """A nan_grad fault through the split-allreduce path with the
+        guard armed: skipped steps must advance BOTH accounting planes —
+        every step event still carries wire bytes, and the quality ring
+        still journals one row per step with the skips flagged, in an
+        unbroken step sequence."""
+        STEPS = 12
+        journal_path = str(tmp_path / "run_journal.jsonl")
+        plan = FaultPlan((FaultSpec("nan_grad", step=4, duration=2,
+                                    worker=1),))
+        cfg = TrainConfig(
+            dnn="mnistnet", dataset="mnist", batch_size=8, lr=0.05,
+            compressor="topkSA", density=0.05,
+            resilience=True, resilience_cooldown=0,
+            obs=True, obs_journal=journal_path,
+            obs_quality=True, obs_quality_every=4)
+        acfg = OkTopkConfig(warmup_steps=0)
+        tr = Trainer(cfg, mesh=mesh4, warmup=False, algo_cfg=acfg,
+                     fault_plan=plan)
+        rng = np.random.RandomState(11)
+        batches = iter([synthetic_batch("mnistnet", 8, rng)
+                        for _ in range(STEPS)])
+        tr.train(batches, STEPS, log_every=100)
+
+        from oktopk_tpu.autotune.journal import read_journal
+        entries = read_journal(journal_path)
+        events = [e["event"] for e in entries]
+        assert validate_journal(entries) == []
+        assert "guard_trip" in events
+
+        # wire accounting advanced on every step, skips included
+        steps = [e for e in entries if e["event"] == "step"]
+        assert len(steps) == STEPS
+        assert all(e.get("wire_bytes", 0) > 0 for e in steps)
+
+        # quality accounting matches: one ring row per step, the guard
+        # skips flagged rather than dropped, step sequence unbroken
+        quality = [e for e in entries if e["event"] == "quality"]
+        all_steps = [s for e in quality for s in e["steps"]]
+        assert all_steps == list(range(1, STEPS + 1))
+        skipped = sum(s for e in quality for s in e["skipped"])
+        assert skipped >= 1, "guard never skipped — fault not exercised"
+        assert skipped < STEPS
 
     def test_journal_default_off_is_free(self, mesh4):
         """obs=False leaves no bus/journal/tracer on the trainer."""

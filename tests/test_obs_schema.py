@@ -166,3 +166,67 @@ class TestValidatorRejects:
             if name == "header":
                 continue
             assert "step" in schema["required"], name
+
+    def test_quality_missing_bucket(self):
+        probs = validate_event({"event": "quality", "step": 8,
+                                "comp_err": [0.1]})
+        assert any("bucket" in p for p in probs)
+
+    def test_quality_null_samples_validate(self):
+        # flush-time NaN sanitisation produces nulls inside the lists
+        assert validate_event({"event": "quality", "step": 8, "bucket": 0,
+                               "algo": "oktopk", "count": 2,
+                               "steps": [7, 8], "comp_err": [None, 0.2],
+                               "skipped": [1, 0]}) == []
+
+    def test_quality_rollup_requires_breaches_list(self):
+        probs = validate_event({"event": "quality_rollup", "step": 8,
+                                "bucket": 0})
+        assert any("breaches" in p for p in probs)
+        probs = validate_event({"event": "quality_rollup", "step": 8,
+                                "bucket": 0, "breaches": "comp_err"})
+        assert any("breaches" in p for p in probs)
+
+    def test_baseline_warning_requires_key_and_reason(self):
+        assert validate_event({"event": "baseline_warning", "step": 0,
+                               "key": "oktopk_ms", "reason": "no records",
+                               "files": 0, "malformed": []}) == []
+        probs = validate_event({"event": "baseline_warning", "step": 0})
+        assert any("key" in p for p in probs)
+        assert any("reason" in p for p in probs)
+
+
+class TestEmitterCompleteness:
+    def test_every_emitted_event_name_has_a_schema(self):
+        """Grep the whole package for bus.emit / journal.record call
+        sites with a literal event name: every one must have an
+        EVENT_SCHEMAS entry, so a new emitter cannot silently journal
+        events the validator (and obs_report --strict) has never heard
+        of."""
+        import os
+        import re
+
+        pkg = os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "oktopk_tpu")
+        pat = re.compile(r"\.(?:emit|record)\(\s*[\"']([a-z_]+)[\"']")
+        found = {}
+        for root, _dirs, files in os.walk(pkg):
+            for fn in files:
+                if not fn.endswith(".py"):
+                    continue
+                path = os.path.join(root, fn)
+                with open(path) as f:
+                    src = f.read()
+                for m in pat.finditer(src):
+                    found.setdefault(m.group(1), []).append(
+                        os.path.relpath(path, pkg))
+        assert found, "emitter scan found nothing — pattern rotted?"
+        # the scan must actually see the known emitters, old and new
+        for known in ("guard_trip", "quality", "quality_rollup",
+                      "baseline_warning"):
+            assert known in found, f"scan missed {known} emitter"
+        unknown = {name: sorted(set(paths))
+                   for name, paths in found.items()
+                   if name not in EVENT_SCHEMAS}
+        assert not unknown, (
+            f"events emitted without a schema entry: {unknown}")
