@@ -103,6 +103,33 @@ def volume_probe():
            "quality_comp_err": sum(comp_errs) / len(comp_errs),
            "quality_eff_density": sum(eff_dens) / len(eff_dens),
            "quality_res_norm": sum(res_norms) / len(res_norms)}
+    # step-anatomy tail (obs/anatomy.py): per-phase breakdown + overlap
+    # scorecard on the same mesh. A missing/failed profiler capture must
+    # not cost the volume headline — it degrades to anatomy_unavailable.
+    try:
+        import tempfile
+        from oktopk_tpu.obs.anatomy import capture_pipeline_anatomy, \
+            phase_totals
+        # capped n: at the probe's full 1M elements the CPU profiler's
+        # event buffer overflows and silently drops the later phase
+        # spans (the phase MIX is the measurement, not absolute ms);
+        # 64K over 2 buckets is the scale verified to capture every span
+        acfg = cfg.replace(n=min(cfg.n, 1 << 16))
+        with tempfile.TemporaryDirectory(prefix="oktopk_anat_") as td:
+            analysis = capture_pipeline_anatomy(
+                acfg, mesh, td, num_buckets=2, iters=2)
+        if analysis is None:
+            out["anatomy_unavailable"] = "no usable profiler capture"
+        else:
+            out["anatomy_phase_ms"] = {
+                k: round(float(v), 4)
+                for k, v in phase_totals(analysis).items()}
+            out["anatomy_overlap_ratio"] = round(
+                float(analysis["overlap_ratio"]), 6)
+            out["anatomy_step_ms"] = round(float(analysis["step_ms"]), 4)
+            out["anatomy_ideal_ms"] = round(float(analysis["ideal_ms"]), 4)
+    except Exception as e:   # profiler quirks must never kill the probe
+        out["anatomy_unavailable"] = repr(e)[:200]
     print("VOLUME_PROBE " + json.dumps(out))
 
 
@@ -429,6 +456,13 @@ def main():
                     "quality_res_norm"):
             if key in probe:
                 rec[key] = round(float(probe[key]), 6)
+        # step-anatomy tail (phase breakdown + overlap scorecard from the
+        # probe subprocess; anatomy_unavailable when capture failed)
+        for key in ("anatomy_phase_ms", "anatomy_overlap_ratio",
+                    "anatomy_step_ms", "anatomy_ideal_ms",
+                    "anatomy_unavailable"):
+            if key in probe:
+                rec[key] = probe[key]
         for key in ("device", "oktopk_ms", "oktopk_ms_std", "dense_ms",
                     "dense_ms_std", "dense_bs256_ms", "dense_bs256_ms_std",
                     "oktopk_bs256_ms", "oktopk_bs256_ms_std",

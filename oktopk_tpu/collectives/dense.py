@@ -16,12 +16,14 @@ from oktopk_tpu.collectives.state import SparseState, bump
 from oktopk_tpu.collectives.wire import dense_wire_bytes
 from oktopk_tpu.comm.primitives import pvary_like
 from oktopk_tpu.config import OkTopkConfig
+from oktopk_tpu.obs.anatomy import phase_scope
 
 
 def dense_allreduce(grad: jnp.ndarray, state: SparseState, cfg: OkTopkConfig,
                     axis_name: str = "data"):
     """psum-mean over the data axis (ring allreduce moves ~2n per worker)."""
-    out = lax.pmean(grad, axis_name)
+    with phase_scope("exchange", cfg.bucket_index):
+        out = lax.pmean(grad, axis_name)
     out, state = pvary_like(
         (out, bump(state, volume=2.0 * cfg.n,
                    wire_bytes=dense_wire_bytes(2.0 * cfg.n),

@@ -19,6 +19,7 @@ import jax.numpy as jnp
 from oktopk_tpu.collectives.state import SparseState, bump
 from oktopk_tpu.comm import all_gather, psum
 from oktopk_tpu.config import OkTopkConfig
+from oktopk_tpu.obs.anatomy import phase_scope
 from oktopk_tpu.ops import gaussian_threshold, scatter_sparse, select_by_threshold
 from oktopk_tpu.ops.residual import add_residual
 from oktopk_tpu.collectives.wire import (
@@ -32,17 +33,24 @@ def gaussian_k(grad: jnp.ndarray, state: SparseState, cfg: OkTopkConfig,
                axis_name: str = "data"):
     P, n, k = cfg.num_workers, cfg.n, cfg.k
     cap = cfg.cap_local
-    acc = add_residual(grad, state.residual)
+    bkt = cfg.bucket_index
+    with phase_scope("select", bkt):
+        acc = add_residual(grad, state.residual)
 
-    t = gaussian_threshold(acc, k, cfg.gaussian_refine_iters).astype(acc.dtype)
-    vals, idx, count = select_by_threshold(
-        acc, t, cap, use_pallas=bool(cfg.use_pallas))
-    packed_mask = jnp.zeros((n,), bool).at[idx].set(True, mode="drop")
-    residual = residual_after_selection(acc, packed_mask, cfg)
+        t = gaussian_threshold(acc, k,
+                               cfg.gaussian_refine_iters).astype(acc.dtype)
+    with phase_scope("stage", bkt):
+        vals, idx, count = select_by_threshold(
+            acc, t, cap, use_pallas=bool(cfg.use_pallas))
+        packed_mask = jnp.zeros((n,), bool).at[idx].set(True, mode="drop")
+        residual = residual_after_selection(acc, packed_mask, cfg)
 
-    gv = all_gather(on_wire(vals, cfg, state.step), axis_name).astype(acc.dtype)
-    gi = all_gather(idx, axis_name)
-    result = scatter_sparse(n, gv, gi) / P
+    with phase_scope("exchange", bkt):
+        gv = all_gather(on_wire(vals, cfg, state.step),
+                        axis_name).astype(acc.dtype)
+        gi = all_gather(idx, axis_name)
+    with phase_scope("combine", bkt):
+        result = scatter_sparse(n, gv, gi) / P
 
     total = psum(count, axis_name)
     return result, bump(state, volume=2.0 * total,

@@ -23,6 +23,7 @@ import jax.numpy as jnp
 from oktopk_tpu.collectives.state import SparseState, bump
 from oktopk_tpu.comm.primitives import ppermute_pair
 from oktopk_tpu.config import OkTopkConfig
+from oktopk_tpu.obs.anatomy import phase_scope
 from oktopk_tpu.ops import exact_topk, scatter_sparse
 from oktopk_tpu.ops.residual import add_residual
 from oktopk_tpu.collectives.wire import (
@@ -38,10 +39,12 @@ def gtopk(grad: jnp.ndarray, state: SparseState, cfg: OkTopkConfig,
     P, n, k = cfg.num_workers, cfg.n, cfg.k
     if P & (P - 1):
         raise ValueError(f"gtopk requires power-of-two workers, got {P}")
-    acc = add_residual(grad, state.residual)
-    vals, idx = exact_topk(acc, k)
-    sel_mask = jnp.zeros((n,), bool).at[idx].set(True)
-    residual = residual_after_selection(acc, sel_mask, cfg)
+    bkt = cfg.bucket_index
+    with phase_scope("select", bkt):
+        acc = add_residual(grad, state.residual)
+        vals, idx = exact_topk(acc, k)
+        sel_mask = jnp.zeros((n,), bool).at[idx].set(True)
+        residual = residual_after_selection(acc, sel_mask, cfg)
 
     rounds = P.bit_length() - 1
     d = 1
@@ -52,13 +55,15 @@ def gtopk(grad: jnp.ndarray, state: SparseState, cfg: OkTopkConfig,
         # the all-ranks-identical-result invariant breaks. The first
         # round's loss is captured by the selection residual above;
         # later rounds re-round merged sums (collectives/wire.py).
-        vals = wire_round(vals, cfg)
-        pv = ppermute_pair(on_wire(vals, cfg, state.step), axis_name, d) \
-            .astype(acc.dtype)            # lossless: vals already rounded
-        pi = ppermute_pair(idx, axis_name, d)
-        merged = scatter_sparse(n, jnp.concatenate([vals, pv]),
-                                jnp.concatenate([idx, pi]))
-        vals, idx = exact_topk(merged, k)
+        with phase_scope("exchange", bkt):
+            vals = wire_round(vals, cfg)
+            pv = ppermute_pair(on_wire(vals, cfg, state.step), axis_name,
+                               d).astype(acc.dtype)  # vals already rounded
+            pi = ppermute_pair(idx, axis_name, d)
+        with phase_scope("combine", bkt):
+            merged = scatter_sparse(n, jnp.concatenate([vals, pv]),
+                                    jnp.concatenate([idx, pi]))
+            vals, idx = exact_topk(merged, k)
         d <<= 1
 
     # Merge losers return to error feedback: the reference's caller keeps
@@ -68,11 +73,12 @@ def gtopk(grad: jnp.ndarray, state: SparseState, cfg: OkTopkConfig,
     # :1406-1411 — residual clears only at selected-AND-won slots).
     # Dropping them loses ~(P-1)/P of the selected gradient mass per step
     # and stalls convergence (observed: mnistnet stuck at chance).
-    winner_mask = jnp.zeros((n,), bool).at[idx].set(True)
-    lost = sel_mask & ~winner_mask
-    residual = jnp.where(lost, acc, residual)
+    with phase_scope("combine", bkt):
+        winner_mask = jnp.zeros((n,), bool).at[idx].set(True)
+        lost = sel_mask & ~winner_mask
+        residual = jnp.where(lost, acc, residual)
 
-    result = scatter_sparse(n, vals, idx) / P
+        result = scatter_sparse(n, vals, idx) / P
     vol = 4.0 * k * rounds
     return result, bump(state, volume=vol,
                         wire_bytes=pair_wire_bytes(2.0 * k * rounds, cfg),

@@ -35,6 +35,7 @@ from jax import lax
 
 from oktopk_tpu.collectives.state import SparseState, bump
 from oktopk_tpu.comm import all_gather, all_to_all, axis_rank, psum
+from oktopk_tpu.obs.anatomy import phase_scope
 from oktopk_tpu.comm.primitives import pvary_like
 from oktopk_tpu.config import OkTopkConfig, scheduled_k
 from oktopk_tpu.ops import (
@@ -141,6 +142,7 @@ def oktopk(grad: jnp.ndarray, state: SparseState, cfg: OkTopkConfig,
     k = scheduled_k(cfg, state.step)
     rank = axis_rank(axis_name)
     up = bool(cfg.use_pallas)
+    bkt = cfg.bucket_index   # anatomy scope names carry the bucket id
     hist_mode = cfg.threshold_method == "hist"
     # Fused selection front-end (ops/fused_select.py): ONE Pallas sweep
     # over (grad, residual) yields acc, the staging rows, the realised and
@@ -152,8 +154,9 @@ def oktopk(grad: jnp.ndarray, state: SparseState, cfg: OkTopkConfig,
     fuse = (up and cfg.fuse_select is not False
             and grad.dtype == jnp.float32)
     if not fuse:
-        acc = add_residual(grad, state.residual)
-        abs_acc = jnp.abs(acc)
+        with phase_scope("select", bkt):
+            acc = add_residual(grad, state.residual)
+            abs_acc = jnp.abs(acc)
 
     def _abs_acc_branch():
         # fused steps carry no precomputed |acc| buffer; the rare branches
@@ -198,8 +201,9 @@ def oktopk(grad: jnp.ndarray, state: SparseState, cfg: OkTopkConfig,
             return k2threshold_hist(_abs_acc_branch(),
                                     tkl).astype(grad.dtype)
 
-        lt = lax.cond(first_sparse, lt_prime,
-                      lambda: prev_lt * state.drift)
+        with phase_scope("select", bkt):
+            lt = lax.cond(first_sparse, lt_prime,
+                          lambda: prev_lt * state.drift)
         drift = state.drift   # re-measured from the histogram below
     else:
         def lt_exact():
@@ -229,8 +233,9 @@ def oktopk(grad: jnp.ndarray, state: SparseState, cfg: OkTopkConfig,
         def lt_predicted():
             return prev_lt * state.drift, state.drift, state.last_exact_lt
 
-        lt, drift, last_exact_lt = lax.cond(recompute_local, lt_exact,
-                                            lt_predicted)
+        with phase_scope("select", bkt):
+            lt, drift, last_exact_lt = lax.cond(recompute_local, lt_exact,
+                                                lt_predicted)
 
     # ---- phase (a): select, exchange to region owners, scatter-add reduce.
     # Region repartition every repartition_every steps (reference
@@ -240,15 +245,17 @@ def oktopk(grad: jnp.ndarray, state: SparseState, cfg: OkTopkConfig,
     # finalize — repartition's extra |acc| sweep prices only its cadence.
     repart = (state.step % cfg.repartition_every == 0) | first_sparse
     if fuse:
-        st = fused_select_stage(grad, state.residual, lt,
-                                lt * cfg.probe_ratio)
-        acc = st.acc
-        boundaries = lax.cond(
-            repart,
-            lambda: _repartition(jnp.abs(acc), lt, cfg, axis_name),
-            lambda: state.boundaries)
-        s_vals, s_idx, s_counts = fused_pack_finalize(
-            st, boundaries, P, cfg.cap_pair)
+        with phase_scope("select", bkt):
+            st = fused_select_stage(grad, state.residual, lt,
+                                    lt * cfg.probe_ratio)
+            acc = st.acc
+        with phase_scope("stage", bkt):
+            boundaries = lax.cond(
+                repart,
+                lambda: _repartition(jnp.abs(acc), lt, cfg, axis_name),
+                lambda: state.boundaries)
+            s_vals, s_idx, s_counts = fused_pack_finalize(
+                st, boundaries, P, cfg.cap_pair)
         local_count = st.local_count
         local_probe = st.probe_count
         hist = st.hist
@@ -260,24 +267,30 @@ def oktopk(grad: jnp.ndarray, state: SparseState, cfg: OkTopkConfig,
         # the first exact recompute.
         mask = jnp.abs(acc) >= lt
     else:
-        boundaries = lax.cond(
-            repart,
-            lambda: _repartition(abs_acc, lt, cfg, axis_name),
-            lambda: state.boundaries)
-        mask = abs_acc >= lt
-        local_count = jnp.sum(mask)
-        s_vals, s_idx, s_counts = pack_by_region(
-            acc, mask, boundaries, P, cfg.cap_pair, thresh=lt,
-            use_pallas=up)
+        with phase_scope("stage", bkt):
+            boundaries = lax.cond(
+                repart,
+                lambda: _repartition(abs_acc, lt, cfg, axis_name),
+                lambda: state.boundaries)
+        with phase_scope("select", bkt):
+            mask = abs_acc >= lt
+            local_count = jnp.sum(mask)
+        with phase_scope("stage", bkt):
+            s_vals, s_idx, s_counts = pack_by_region(
+                acc, mask, boundaries, P, cfg.cap_pair, thresh=lt,
+                use_pallas=up)
         # threshold feedback probe (fuses into the same pass over abs_acc)
-        local_probe = jnp.sum(abs_acc >= lt * cfg.probe_ratio)
+        with phase_scope("select", bkt):
+            local_probe = jnp.sum(abs_acc >= lt * cfg.probe_ratio)
         # "hist" standalone pays its one histogram pass lazily, inside the
         # recompute cond below (the fused kernel emits it for free)
         hist = None
-    r_vals = all_to_all(_on_wire(s_vals, cfg, state.step), axis_name) \
-        .astype(acc.dtype)                     # [P, cap_pair]
-    r_idx = all_to_all(s_idx, axis_name)
-    reduced = scatter_sparse(n, r_vals, r_idx)  # nonzero only in own region
+    with phase_scope("exchange", bkt):
+        r_vals = all_to_all(_on_wire(s_vals, cfg, state.step), axis_name) \
+            .astype(acc.dtype)                 # [P, cap_pair]
+        r_idx = all_to_all(s_idx, axis_name)
+    with phase_scope("combine", bkt):
+        reduced = scatter_sparse(n, r_vals, r_idx)  # own region only
 
     # Wire volume: the capped buffers bound what is actually sent (elements
     # beyond cap stay in the residual) — unlike the reference, whose MPI
@@ -313,11 +326,13 @@ def oktopk(grad: jnp.ndarray, state: SparseState, cfg: OkTopkConfig,
                                   target=tkl),
                     state.drift, state.last_exact_lt)
 
-        lt_next, drift, last_exact_lt = lax.cond(recompute_local,
-                                                 lt_measured, lt_adapted)
+        with phase_scope("select", bkt):
+            lt_next, drift, last_exact_lt = lax.cond(recompute_local,
+                                                     lt_measured, lt_adapted)
     else:
-        lt_next = _newton_adapt(lt, local_count, local_probe, k, cfg,
-                                target=tkl)
+        with phase_scope("select", bkt):
+            lt_next = _newton_adapt(lt, local_count, local_probe, k, cfg,
+                                    target=tkl)
 
     # ---- phase (b): global winner selection + allgather.
     cap_g = cfg.cap_gather
@@ -333,33 +348,39 @@ def oktopk(grad: jnp.ndarray, state: SparseState, cfg: OkTopkConfig,
         # paper's volume O(k), not O(kP)) selected by a sort-free
         # per-region threshold; the k-th value of the gathered pool becomes
         # the new global threshold. No O(n log n) sort anywhere.
-        t_cand = k2threshold_method(jnp.abs(reduced), k_cand,
-                                    cfg.threshold_method, cfg.bisect_iters)
-        if up:
-            # the kernel's min-normal clamp already excludes zeros
-            vals, idx, cand_count = select_by_threshold(
-                reduced, t_cand, k_cand, use_pallas=True)
-        else:
-            cand_mask = (jnp.abs(reduced) >= t_cand) & (reduced != 0.0)
-            vals, idx, cand_count = select_mask(reduced, cand_mask, k_cand)
-        gv = all_gather(_on_wire(vals, cfg, state.step), axis_name) \
-            .astype(acc.dtype)                         # [P, k_cand]
-        gi = all_gather(idx, axis_name)
+        with phase_scope("select", bkt):
+            t_cand = k2threshold_method(jnp.abs(reduced), k_cand,
+                                        cfg.threshold_method,
+                                        cfg.bisect_iters)
+            if up:
+                # the kernel's min-normal clamp already excludes zeros
+                vals, idx, cand_count = select_by_threshold(
+                    reduced, t_cand, k_cand, use_pallas=True)
+            else:
+                cand_mask = (jnp.abs(reduced) >= t_cand) & (reduced != 0.0)
+                vals, idx, cand_count = select_mask(reduced, cand_mask,
+                                                    k_cand)
+        with phase_scope("exchange", bkt):
+            gv = all_gather(_on_wire(vals, cfg, state.step), axis_name) \
+                .astype(acc.dtype)                     # [P, k_cand]
+            gi = all_gather(idx, axis_name)
         # Python min when k is static (the "sort" method needs it so);
         # a scheduled k is traced, and the schedule guarantees "bisect"
         # (count-based, traced-k-capable)
         k_pool = (min(k, P * k_cand) if isinstance(k, int)
                   else jnp.minimum(k, P * k_cand))
-        gt = k2threshold_method(jnp.abs(gv).reshape(-1), k_pool,
-                                cfg.threshold_method,
-                                cfg.bisect_iters).astype(acc.dtype)
-        keep = (jnp.abs(gv) >= gt) & (gi < n)
+        with phase_scope("select", bkt):
+            gt = k2threshold_method(jnp.abs(gv).reshape(-1), k_pool,
+                                    cfg.threshold_method,
+                                    cfg.bisect_iters).astype(acc.dtype)
+            keep = (jnp.abs(gv) >= gt) & (gi < n)
         # values pre-divided by P at cap scale: every gathered index is
         # unique (regions are disjoint and each worker's winners are
         # deduplicated), so scatter(gv / P) == scatter(gv) / P bit-for-bit
         # — and the old dense n-scale division pass disappears
-        result = scatter_sparse(n, jnp.where(keep, gv, 0.0) / P,
-                                jnp.where(keep, gi, n))
+        with phase_scope("combine", bkt):
+            result = scatter_sparse(n, jnp.where(keep, gv, 0.0) / P,
+                                    jnp.where(keep, gi, n))
         g_count = jnp.sum(keep)
         total_c = psum(cand_count, axis_name)
         vol = 2.0 * cand_count + 2.0 * (total_c - cand_count)
@@ -374,12 +395,16 @@ def oktopk(grad: jnp.ndarray, state: SparseState, cfg: OkTopkConfig,
         # drift rate (see the local-threshold block above) at zero comm
         # cost.
         gt_use = state.global_threshold * drift
-        gvals, gidx, gcount = select_by_threshold(reduced, gt_use, cap_g,
-                                                  use_pallas=up)
-        gv = all_gather(_on_wire(gvals, cfg, state.step), axis_name) \
-            .astype(acc.dtype)                         # [P, cap_g]
-        gi = all_gather(gidx, axis_name)
-        result = scatter_sparse(n, gv / P, gi)  # pre-divided (see exact_branch)
+        with phase_scope("select", bkt):
+            gvals, gidx, gcount = select_by_threshold(reduced, gt_use,
+                                                      cap_g, use_pallas=up)
+        with phase_scope("exchange", bkt):
+            gv = all_gather(_on_wire(gvals, cfg, state.step), axis_name) \
+                .astype(acc.dtype)                     # [P, cap_g]
+            gi = all_gather(gidx, axis_name)
+        with phase_scope("combine", bkt):
+            result = scatter_sparse(n, gv / P, gi)  # pre-divided
+            # (see exact_branch)
         # Newton probe count rides the same psum as the realised count —
         # one 2-vector allreduce (the reference pays a full size-exchange
         # Allgather for less information, VGG/allreducer.py:807)
@@ -404,8 +429,10 @@ def oktopk(grad: jnp.ndarray, state: SparseState, cfg: OkTopkConfig,
     # result/P + winner_mask + residual trio collapses into ONE consumer
     # pass over (result, acc, reduced) — the last n-scale sweep of the
     # step (docs/PERF.md "selection hot path").
-    winner_mask = result != 0.0
-    residual = residual_after_winners(acc, winner_mask, mask, reduced, cfg)
+    with phase_scope("combine", bkt):
+        winner_mask = result != 0.0
+        residual = residual_after_winners(acc, winner_mask, mask, reduced,
+                                          cfg)
 
     # Both phases move (index, value) pairs and count volume as scalars
     # (2 per pair), so the realised wire bytes follow from the same

@@ -17,6 +17,7 @@ from jax import lax
 
 from oktopk_tpu.collectives.state import SparseState, bump
 from oktopk_tpu.comm import all_gather, psum
+from oktopk_tpu.obs.anatomy import phase_scope
 from oktopk_tpu.config import OkTopkConfig
 from oktopk_tpu.ops import (
     exact_topk,
@@ -47,14 +48,19 @@ def topk_a(grad: jnp.ndarray, state: SparseState, cfg: OkTopkConfig,
     """topkA: exact local top-k, allgather of [P, k] values+indices,
     scatter-add, mean (reference VGG/allreducer.py:34-69)."""
     P, n, k = cfg.num_workers, cfg.n, cfg.k
-    acc = add_residual(grad, state.residual)
-    vals, idx = exact_topk(acc, k)
-    sel_mask = jnp.zeros((n,), bool).at[idx].set(True)
-    residual = residual_after_selection(acc, sel_mask, cfg)
+    bkt = cfg.bucket_index
+    with phase_scope("select", bkt):
+        acc = add_residual(grad, state.residual)
+        vals, idx = exact_topk(acc, k)
+        sel_mask = jnp.zeros((n,), bool).at[idx].set(True)
+        residual = residual_after_selection(acc, sel_mask, cfg)
 
-    gv = all_gather(on_wire(vals, cfg, state.step), axis_name).astype(acc.dtype)  # [P, k]
-    gi = all_gather(idx, axis_name)           # [P, k]
-    result = scatter_sparse(n, gv, gi) / P
+    with phase_scope("exchange", bkt):
+        gv = all_gather(on_wire(vals, cfg, state.step),
+                        axis_name).astype(acc.dtype)   # [P, k]
+        gi = all_gather(idx, axis_name)                # [P, k]
+    with phase_scope("combine", bkt):
+        result = scatter_sparse(n, gv, gi) / P
 
     vol = 2.0 * k + 2.0 * k * (P - 1)         # send + receive, idx+val scalars
     return result, bump(state, volume=vol,
@@ -69,8 +75,9 @@ def topk_a2(grad: jnp.ndarray, state: SparseState, cfg: OkTopkConfig,
     update is exactly k-sparse (reference VGG/allreducer.py:519-525)."""
     result, new_state = topk_a(grad, state, cfg, axis_name)
     k = cfg.k
-    vals, idx = exact_topk(result, k)
-    result2 = scatter_sparse(cfg.n, vals, idx)
+    with phase_scope("combine", cfg.bucket_index):
+        vals, idx = exact_topk(result, k)
+        result2 = scatter_sparse(cfg.n, vals, idx)
     return result2, new_state
 
 
@@ -81,25 +88,31 @@ def topk_a_opt(grad: jnp.ndarray, state: SparseState, cfg: OkTopkConfig,
     fixed-capacity allgather (reference VGG/allreducer.py:1100-1151)."""
     P, n, k = cfg.num_workers, cfg.n, cfg.k
     cap = cfg.cap_local
-    acc = add_residual(grad, state.residual)
-    abs_acc = jnp.abs(acc)
+    bkt = cfg.bucket_index
+    with phase_scope("select", bkt):
+        acc = add_residual(grad, state.residual)
+        abs_acc = jnp.abs(acc)
 
-    recompute = ((state.step % cfg.local_recompute_every == 0)
-                 | (state.step == cfg.warmup_steps))  # see oktopk.py
-    lt = lax.cond(recompute,
-                  lambda: k2threshold_method(
-                      abs_acc, k, cfg.threshold_method,
-                      cfg.bisect_iters).astype(acc.dtype),
-                  lambda: state.local_threshold)
+        recompute = ((state.step % cfg.local_recompute_every == 0)
+                     | (state.step == cfg.warmup_steps))  # see oktopk.py
+        lt = lax.cond(recompute,
+                      lambda: k2threshold_method(
+                          abs_acc, k, cfg.threshold_method,
+                          cfg.bisect_iters).astype(acc.dtype),
+                      lambda: state.local_threshold)
 
-    vals, idx, count = select_by_threshold(
-        acc, lt, cap, use_pallas=bool(cfg.use_pallas))
-    packed_mask = jnp.zeros((n,), bool).at[idx].set(True, mode="drop")
-    residual = residual_after_selection(acc, packed_mask, cfg)
+    with phase_scope("stage", bkt):
+        vals, idx, count = select_by_threshold(
+            acc, lt, cap, use_pallas=bool(cfg.use_pallas))
+        packed_mask = jnp.zeros((n,), bool).at[idx].set(True, mode="drop")
+        residual = residual_after_selection(acc, packed_mask, cfg)
 
-    gv = all_gather(on_wire(vals, cfg, state.step), axis_name).astype(acc.dtype)
-    gi = all_gather(idx, axis_name)
-    result = scatter_sparse(n, gv, gi) / P
+    with phase_scope("exchange", bkt):
+        gv = all_gather(on_wire(vals, cfg, state.step),
+                        axis_name).astype(acc.dtype)
+        gi = all_gather(idx, axis_name)
+    with phase_scope("combine", bkt):
+        result = scatter_sparse(n, gv, gi) / P
 
     total = psum(count, axis_name)
     lt_next = _adapt_threshold(lt, count, k, cfg)

@@ -23,6 +23,7 @@ from oktopk_tpu.collectives.state import SparseState, bump
 from oktopk_tpu.comm import all_gather, all_to_all, axis_rank, psum
 from oktopk_tpu.comm.primitives import pvary_like
 from oktopk_tpu.config import OkTopkConfig
+from oktopk_tpu.obs.anatomy import phase_scope
 from oktopk_tpu.ops import (
     gaussian_threshold,
     pack_by_region,
@@ -45,16 +46,22 @@ def _split_allreduce(acc, lt, state: SparseState, cfg: OkTopkConfig,
     scatter-add -> gather phase (sparse allgather or dense-fallback psum)."""
     P, n, k = cfg.num_workers, cfg.n, cfg.k
     rank = axis_rank(axis_name)
+    bkt = cfg.bucket_index
     boundaries = state.boundaries      # static equal split from init_state
 
-    mask = jnp.abs(acc) >= lt
-    local_count = jnp.sum(mask)
-    s_vals, s_idx, s_counts = pack_by_region(
-        acc, mask, boundaries, P, cfg.cap_pair, thresh=lt,
-        use_pallas=bool(cfg.use_pallas))
-    r_vals = all_to_all(on_wire(s_vals, cfg, state.step), axis_name).astype(acc.dtype)
-    r_idx = all_to_all(s_idx, axis_name)
-    reduced = scatter_sparse(n, r_vals, r_idx)
+    with phase_scope("select", bkt):
+        mask = jnp.abs(acc) >= lt
+        local_count = jnp.sum(mask)
+    with phase_scope("stage", bkt):
+        s_vals, s_idx, s_counts = pack_by_region(
+            acc, mask, boundaries, P, cfg.cap_pair, thresh=lt,
+            use_pallas=bool(cfg.use_pallas))
+    with phase_scope("exchange", bkt):
+        r_vals = all_to_all(on_wire(s_vals, cfg, state.step),
+                            axis_name).astype(acc.dtype)
+        r_idx = all_to_all(s_idx, axis_name)
+    with phase_scope("combine", bkt):
+        reduced = scatter_sparse(n, r_vals, r_idx)
 
     sent_count = jnp.sum(s_counts)   # capped wire volume (see oktopk.py)
     recv_count = jnp.sum(r_idx < n)
@@ -67,11 +74,15 @@ def _split_allreduce(acc, lt, state: SparseState, cfg: OkTopkConfig,
     cap_g = cfg.cap_local
 
     def sparse_gather():
-        gvals, gidx, gcount = select_nonzero(
-            reduced, cap_g, use_pallas=bool(cfg.use_pallas))
-        gv = all_gather(on_wire(gvals, cfg, state.step), axis_name).astype(acc.dtype)
-        gi = all_gather(gidx, axis_name)
-        result = scatter_sparse(n, gv, gi)
+        with phase_scope("select", bkt):
+            gvals, gidx, gcount = select_nonzero(
+                reduced, cap_g, use_pallas=bool(cfg.use_pallas))
+        with phase_scope("exchange", bkt):
+            gv = all_gather(on_wire(gvals, cfg, state.step),
+                            axis_name).astype(acc.dtype)
+            gi = all_gather(gidx, axis_name)
+        with phase_scope("combine", bkt):
+            result = scatter_sparse(n, gv, gi)
         total = psum(gcount, axis_name)
         vol = 2.0 * gcount + 2.0 * (total - gcount)
         return pvary_like((result, vol, pair_wire_bytes(total, cfg),
@@ -95,10 +106,11 @@ def _split_allreduce(acc, lt, state: SparseState, cfg: OkTopkConfig,
     else:
         result, vol_b, wb_b, gather_rounded = sparse_gather()
 
-    result = result / P
-    winner_mask = result != 0.0
-    residual = residual_after_winners(acc, winner_mask, mask, reduced, cfg,
-                                      owner_scale=gather_rounded)
+    with phase_scope("combine", bkt):
+        result = result / P
+        winner_mask = result != 0.0
+        residual = residual_after_winners(acc, winner_mask, mask, reduced,
+                                          cfg, owner_scale=gather_rounded)
     wb = pair_wire_bytes(0.5 * vol_a, cfg) + wb_b
     return result, residual, vol_a + vol_b, wb, local_count, total_nnz
 
@@ -108,15 +120,16 @@ def topk_sa(grad: jnp.ndarray, state: SparseState, cfg: OkTopkConfig,
     """topkSA / "topkDSA": predicted top-k threshold + static split-allreduce
     (reference VGG/allreducer.py:1153-1357)."""
     k = cfg.k
-    acc = add_residual(grad, state.residual)
-    abs_acc = jnp.abs(acc)
-    recompute = ((state.step % cfg.local_recompute_every == 0)
-                 | (state.step == cfg.warmup_steps))  # see oktopk.py
-    lt = lax.cond(recompute,
-                  lambda: k2threshold_method(
-                      abs_acc, k, cfg.threshold_method,
-                      cfg.bisect_iters).astype(acc.dtype),
-                  lambda: state.local_threshold)
+    with phase_scope("select", cfg.bucket_index):
+        acc = add_residual(grad, state.residual)
+        abs_acc = jnp.abs(acc)
+        recompute = ((state.step % cfg.local_recompute_every == 0)
+                     | (state.step == cfg.warmup_steps))  # see oktopk.py
+        lt = lax.cond(recompute,
+                      lambda: k2threshold_method(
+                          abs_acc, k, cfg.threshold_method,
+                          cfg.bisect_iters).astype(acc.dtype),
+                      lambda: state.local_threshold)
     result, residual, vol, wb, lc, gc = _split_allreduce(
         acc, lt, state, cfg, axis_name, dense_fallback=True)
     grow = lc > cfg.band_hi * k
@@ -132,8 +145,10 @@ def gaussian_k_sa(grad: jnp.ndarray, state: SparseState, cfg: OkTopkConfig,
                   axis_name: str = "data"):
     """gaussiankSA: Gaussian per-step threshold + static split-allreduce
     (reference VGG/allreducer.py:1503-1620)."""
-    acc = add_residual(grad, state.residual)
-    t = gaussian_threshold(acc, cfg.k, cfg.gaussian_refine_iters).astype(acc.dtype)
+    with phase_scope("select", cfg.bucket_index):
+        acc = add_residual(grad, state.residual)
+        t = gaussian_threshold(acc, cfg.k,
+                               cfg.gaussian_refine_iters).astype(acc.dtype)
     result, residual, vol, wb, lc, gc = _split_allreduce(
         acc, t, state, cfg, axis_name, dense_fallback=False)
     return result, bump(state, volume=vol, wire_bytes=wb, residual=residual,

@@ -12,7 +12,7 @@ testable, and hashable (usable as a static arg under jit).
 from __future__ import annotations
 
 import dataclasses
-from typing import Optional, Tuple
+from typing import Dict, Optional, Tuple
 
 
 @dataclasses.dataclass(frozen=True)
@@ -439,6 +439,10 @@ class TrainConfig:
     obs_regress_key: Optional[str] = None
     # Step time above tolerance x baseline journals a regression event.
     obs_regress_tolerance: float = 1.5
+    # Per-phase duration limits in milliseconds ({"exchange": 50.0, ...});
+    # a host-phase summary entry above its limit journals a regression
+    # event with key="phase:<name>" (obs/regress.py observe_phases).
+    obs_phase_limits: Optional[Dict[str, float]] = None
     # ---- signal-fidelity telemetry (obs/quality.py) -------------------
     # When True (with obs) the jitted step computes per-bucket fidelity
     # scalars — compression error vs the pre-selection dense gradient,

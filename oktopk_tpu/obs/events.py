@@ -219,6 +219,37 @@ EVENT_SCHEMAS: Dict[str, Dict[str, Dict[str, tuple]]] = {
         "required": {"step": _NUM, "key": _STR, "reason": _STR},
         "optional": {"files": _NUM, "malformed": _LIST},
     },
+    # step-anatomy attribution for one bucket (obs/anatomy.py): phases
+    # maps phase name -> {"ms", "count", "lane"}; model-level unbucketed
+    # phases (fwd_bwd, optimizer) land on bucket -1. "source" says how
+    # the trace was captured ("host_probe" for the CPU per-phase
+    # dispatch driver, "trace" for an in-jit device capture).
+    "step_anatomy": {
+        "required": {"step": _NUM, "bucket": _NUM, "phases": _DICT},
+        "optional": {"total_ms": _NUM, "source": _STR,
+                     "schema_version": _NUM},
+    },
+    # the overlap scorecard for one captured step (obs/anatomy.py):
+    # compute/comm lane unions, their intersection, overlap_ratio =
+    # overlap_ms / comm_ms, the measured span vs the ideal
+    # fully-overlapped lower bound max(compute, comm), and the
+    # critical-path split of the span across phases
+    "overlap_report": {
+        "required": {"step": _NUM, "compute_ms": _NUM, "comm_ms": _NUM,
+                     "overlap_ms": _NUM, "overlap_ratio": _NUM},
+        "optional": {"step_ms": _NUM, "ideal_ms": _NUM,
+                     "serialization_ms": _NUM, "critical_path": _DICT,
+                     "critical_phase": _OPT_STR, "num_buckets": _NUM,
+                     "events": _NUM, "source": _STR,
+                     "schema_version": _NUM},
+    },
+    # anatomy capture/analysis could not produce an attribution
+    # (missing profiler, empty or malformed trace, no contract-scoped
+    # events) — advisory, journalled instead of raising
+    "anatomy_warning": {
+        "required": {"step": _NUM, "reason": _STR},
+        "optional": {"path": _OPT_STR, "source": _STR},
+    },
 }
 
 

@@ -1,15 +1,18 @@
-"""Prometheus-textfile export of the quality telemetry plane.
+"""Prometheus-textfile export of the quality + anatomy telemetry planes.
 
 Renders the LATEST ``quality_rollup`` per bucket (plus run-level
-counters) in the node-exporter textfile-collector format, so a run's
-fidelity posture can be scraped next to its host metrics without any
-bespoke collector:
+counters) and the latest step-anatomy attribution (per-phase durations
+from ``step_anatomy``, the overlap scorecard from ``overlap_report``)
+in the node-exporter textfile-collector format, so a run's fidelity
+and time-domain posture can be scraped next to its host metrics
+without any bespoke collector:
 
     python scripts/obs_report.py run_journal.jsonl --prom quality.prom
 
-Gauges carry ``bucket`` and ``algo`` labels; every exposition is
-self-describing (# HELP / # TYPE) and deterministic in ordering so
-textfile diffs are meaningful in CI.
+Gauges carry ``bucket`` and ``algo`` labels (anatomy phases add
+``phase``/``lane``); every exposition is self-describing
+(# HELP / # TYPE) and deterministic in ordering so textfile diffs are
+meaningful in CI.
 """
 
 from __future__ import annotations
@@ -35,6 +38,60 @@ _GAUGES = (
 
 def _esc(v: Any) -> str:
     return str(v).replace("\\", "\\\\").replace('"', '\\"')
+
+
+_ANATOMY_PREFIX = "oktopk_anatomy"
+
+# overlap_report field -> (gauge suffix == field, help text)
+_OVERLAP_GAUGES = (
+    ("overlap_ratio", "fraction of collective time hidden under compute "
+                      "(overlap_ms / comm_ms)"),
+    ("compute_ms", "union of compute-lane time in the captured step"),
+    ("comm_ms", "union of collective-lane time in the captured step"),
+    ("overlap_ms", "compute/collective lane intersection"),
+    ("step_ms", "measured captured-step span"),
+    ("ideal_ms", "fully-overlapped lower bound max(compute, comm)"),
+    ("serialization_ms", "measured span above the ideal lower bound"),
+)
+
+
+def _render_anatomy(entries: List[Dict[str, Any]]) -> List[str]:
+    """Gauge lines for the newest step_anatomy (per bucket) and
+    overlap_report events; [] when the journal carries neither."""
+    latest_anat: Dict[int, Dict[str, Any]] = {}
+    latest_overlap: Dict[str, Any] = {}
+    for e in entries:
+        if e.get("event") == "step_anatomy":
+            latest_anat[int(e.get("bucket", 0))] = e
+        elif e.get("event") == "overlap_report":
+            latest_overlap = e
+    lines: List[str] = []
+    name = f"{_ANATOMY_PREFIX}_phase_ms"
+    samples = []
+    for b in sorted(latest_anat):
+        phases = latest_anat[b].get("phases")
+        if not isinstance(phases, dict):
+            continue
+        for ph in sorted(phases):
+            d = phases[ph] if isinstance(phases[ph], dict) else {}
+            v = d.get("ms", phases[ph])
+            if isinstance(v, (int, float)) and math.isfinite(float(v)):
+                labels = (f'bucket="{b}",phase="{_esc(ph)}",'
+                          f'lane="{_esc(d.get("lane", "compute"))}"')
+                samples.append(f"{name}{{{labels}}} {float(v):.10g}")
+    if samples:
+        lines.append(f"# HELP {name} per-phase attributed device/probe "
+                     "time from the latest step-anatomy capture")
+        lines.append(f"# TYPE {name} gauge")
+        lines.extend(samples)
+    for field, help_text in _OVERLAP_GAUGES:
+        v = latest_overlap.get(field)
+        if isinstance(v, (int, float)) and math.isfinite(float(v)):
+            name = f"{_ANATOMY_PREFIX}_{field}"
+            lines.append(f"# HELP {name} {help_text}")
+            lines.append(f"# TYPE {name} gauge")
+            lines.append(f"{name} {float(v):.10g}")
+    return lines
 
 
 def render_prometheus(entries: List[Dict[str, Any]]) -> str:
@@ -78,6 +135,7 @@ def render_prometheus(entries: List[Dict[str, Any]]) -> str:
                       f'algo="{_esc(latest[b].get("algo", "?"))}"')
             lines.append(f"{name}{{{labels}}} "
                          f"{int(latest[b].get('step', 0))}")
+    lines.extend(_render_anatomy(entries))
     return "\n".join(lines) + ("\n" if lines else "")
 
 

@@ -16,7 +16,9 @@ skips the first observations — compile time dominates them and would
 always "regress". ``observe_quality`` additionally checks fidelity
 summary fields (from the quality telemetry plane, obs/quality.py)
 against configured limits, journalling ``regression`` events with
-``key="quality:<field>"``.
+``key="quality:<field>"``. ``observe_phases`` does the same for
+per-phase durations (host PhaseTimers summaries or the anatomy plane's
+phase totals) against ``phase_limits``, with ``key="phase:<name>"``.
 """
 
 from __future__ import annotations
@@ -77,13 +79,15 @@ class RegressionDetector:
     def __init__(self, baseline_ms: Optional[float],
                  tolerance: float = 1.5, warmup_windows: int = 2,
                  bus=None, key: Optional[str] = None,
-                 quality_limits: Optional[Dict[str, float]] = None):
+                 quality_limits: Optional[Dict[str, float]] = None,
+                 phase_limits: Optional[Dict[str, float]] = None):
         self.baseline_ms = baseline_ms
         self.tolerance = float(tolerance)
         self.warmup_windows = int(warmup_windows)
         self.bus = bus
         self.key = key
         self.quality_limits = dict(quality_limits or {})
+        self.phase_limits = dict(phase_limits or {})
         self.observations = 0
         self.flagged: List[Dict[str, Any]] = []
 
@@ -144,6 +148,36 @@ class RegressionDetector:
             rec = {"step": int(step), "ms": val,
                    "baseline_ms": float(limit), "ratio": val / float(limit),
                    "tolerance": 1.0, "key": f"quality:{field}"}
+            flagged.append(rec)
+            self.flagged.append(rec)
+            if self.bus is not None:
+                self.bus.emit("regression", **rec)
+        return flagged
+
+    def observe_phases(self, step: int,
+                       phases: Dict[str, Any]) -> List[Dict[str, Any]]:
+        """Check per-phase durations against ``phase_limits``
+        (``{"exchange": 50.0, ...}``, milliseconds). ``phases`` maps
+        phase name to a plain ms number OR a stats dict (a PhaseTimers
+        summary entry or a step_anatomy phase entry) — ``ms`` then
+        ``mean_ms`` is read from it. Each exceeded limit journals a
+        ``regression`` with ``key="phase:<name>"``, the same event the
+        retune feedback window votes on. No warmup gating: the caller
+        feeds post-compile summaries."""
+        flagged: List[Dict[str, Any]] = []
+        for name, limit in self.phase_limits.items():
+            val = phases.get(name)
+            if isinstance(val, dict):
+                val = val.get("ms", val.get("mean_ms"))
+            if not isinstance(val, (int, float)) or isinstance(val, bool) \
+                    or float(limit) <= 0:
+                continue
+            val = float(val)
+            if val != val or val <= float(limit):   # NaN or within limit
+                continue
+            rec = {"step": int(step), "ms": val,
+                   "baseline_ms": float(limit), "ratio": val / float(limit),
+                   "tolerance": 1.0, "key": f"phase:{name}"}
             flagged.append(rec)
             self.flagged.append(rec)
             if self.bus is not None:

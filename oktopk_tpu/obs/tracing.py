@@ -111,22 +111,54 @@ class AnomalyTracer:
 
 
 class ChromeTraceSink:
-    """Collects host phase samples as Chrome trace-event JSON."""
+    """Collects host phase samples as Chrome trace-event JSON.
+
+    Each bucket/phase family gets its own tid (first-seen order), so
+    Perfetto renders one row per family instead of interleaving every
+    sample on a single track; ``write()`` prepends trace metadata
+    ("M") events naming the process and each lane. Output stays
+    backward-readable: the "X" events carry the same fields as before
+    (plus distinct tids) and old consumers that only scan "X" events
+    see an identical payload shape.
+    """
 
     def __init__(self):
         self.events: List[Dict[str, Any]] = []
+        self._lanes: Dict[str, int] = {}
+
+    def _lane(self, name: str) -> str:
+        """Lane key for one sample: anatomy-contract names group by
+        (bucket, phase) family; anything else gets its own row."""
+        from oktopk_tpu.obs.anatomy import parse_scope, scope_name
+        parsed = parse_scope(name)
+        if parsed is not None and parsed != (None, None):
+            return scope_name(*parsed)
+        return name
 
     def add(self, name: str, ts_s: float, dur_s: float):
         """One complete ("X") event; times in seconds (host clock)."""
+        tid = self._lanes.setdefault(self._lane(name), len(self._lanes))
         self.events.append({
-            "name": name, "ph": "X", "pid": 0, "tid": 0,
+            "name": name, "ph": "X", "pid": 0, "tid": tid,
             "ts": float(ts_s) * 1e6, "dur": float(dur_s) * 1e6,
         })
+
+    def _metadata_events(self) -> List[Dict[str, Any]]:
+        meta: List[Dict[str, Any]] = [{
+            "name": "process_name", "ph": "M", "pid": 0, "tid": 0,
+            "args": {"name": "oktopk host phases"},
+        }]
+        for lane, tid in sorted(self._lanes.items(), key=lambda kv: kv[1]):
+            meta.append({"name": "thread_name", "ph": "M", "pid": 0,
+                         "tid": tid, "args": {"name": lane}})
+            meta.append({"name": "thread_sort_index", "ph": "M", "pid": 0,
+                         "tid": tid, "args": {"sort_index": tid}})
+        return meta
 
     def write(self, path: str) -> str:
         d = os.path.dirname(os.path.abspath(path))
         os.makedirs(d, exist_ok=True)
         with open(path, "w") as f:
-            json.dump({"traceEvents": self.events,
+            json.dump({"traceEvents": self._metadata_events() + self.events,
                        "displayTimeUnit": "ms"}, f)
         return path

@@ -57,6 +57,7 @@ from oktopk_tpu.ops.compaction import (
     _stage_tile,
     _vma_of,
 )
+from oktopk_tpu.obs.anatomy import phase_scope
 from oktopk_tpu.ops.hist_threshold import HIST_BINS, log2_bins, log2_hist
 
 
@@ -197,6 +198,16 @@ def fused_select_stage(grad: jnp.ndarray, residual: jnp.ndarray, thresh,
         interpret = _interpret_default()
     if grad.shape != residual.shape:
         raise ValueError(f"grad {grad.shape} != residual {residual.shape}")
+    # the anatomy scope lives INSIDE the jitted wrapper so the contract
+    # name reaches this program's own op metadata (a caller-side scope
+    # stops at the nested pjit call op)
+    with phase_scope("select"):
+        return _fused_select_stage_impl(grad, residual, thresh,
+                                        probe_thresh, interpret)
+
+
+def _fused_select_stage_impl(grad, residual, thresh, probe_thresh,
+                             interpret):
     n = grad.size
     pad = (-n) % (SB * BLK)
     gp = jnp.pad(grad.reshape(-1), (0, pad)).reshape(-1, BLK_COLS)
@@ -237,9 +248,10 @@ def fused_pack_finalize(st: FusedStage, boundaries, num_regions: int,
     nblocks = st.w_f.shape[0]
     bnd = jnp.asarray(boundaries, jnp.int32)
     vma = _vma_of(st.accp)
-    return _pack_finalize(st.accp, st.accflat, st.t, st.rng, bnd,
-                          num_regions, cap, nblocks, n, interpret, vma,
-                          st.w_f, st.stored_f, st.raw)
+    with phase_scope("stage"):
+        return _pack_finalize(st.accp, st.accflat, st.t, st.rng, bnd,
+                              num_regions, cap, nblocks, n, interpret, vma,
+                              st.w_f, st.stored_f, st.raw)
 
 
 @functools.partial(jax.jit,

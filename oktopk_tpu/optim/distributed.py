@@ -31,6 +31,7 @@ from oktopk_tpu.collectives.registry import get_algorithm
 from oktopk_tpu.collectives.state import SparseState, init_state
 from oktopk_tpu.comm import compat
 from oktopk_tpu.config import OkTopkConfig
+from oktopk_tpu.obs.anatomy import phase_scope
 
 
 @flax.struct.dataclass
@@ -269,22 +270,25 @@ def build_sparse_grad_step(
             return (acc_grads, acc_loss + loss, model_state, rng), None
 
         zero_grads = jax.tree.map(jnp.zeros_like, state.params)
-        if nsteps_update > 1:
-            mb_batch = jax.tree.map(
-                lambda x: x.reshape((nsteps_update, -1) + x.shape[1:]), batch)
-            (grads, loss, model_state, rng), _ = lax.scan(
-                micro, (zero_grads, 0.0, state.model_state, rng), mb_batch)
-            grads = jax.tree.map(lambda g: g / nsteps_update, grads)
-            loss = loss / nsteps_update
-        else:
-            (grads, loss, model_state, rng), _ = micro(
-                (zero_grads, 0.0, state.model_state, rng), batch)
+        with phase_scope("fwd_bwd"):
+            if nsteps_update > 1:
+                mb_batch = jax.tree.map(
+                    lambda x: x.reshape((nsteps_update, -1) + x.shape[1:]),
+                    batch)
+                (grads, loss, model_state, rng), _ = lax.scan(
+                    micro, (zero_grads, 0.0, state.model_state, rng),
+                    mb_batch)
+                grads = jax.tree.map(lambda g: g / nsteps_update, grads)
+                loss = loss / nsteps_update
+            else:
+                (grads, loss, model_state, rng), _ = micro(
+                    (zero_grads, 0.0, state.model_state, rng), batch)
 
-        if grad_clip is not None:
-            gnorm = jnp.sqrt(sum(jnp.sum(g ** 2)
-                                 for g in jax.tree.leaves(grads)))
-            scale = jnp.minimum(1.0, grad_clip / (gnorm + 1e-12))
-            grads = jax.tree.map(lambda g: g * scale, grads)
+            if grad_clip is not None:
+                gnorm = jnp.sqrt(sum(jnp.sum(g ** 2)
+                                     for g in jax.tree.leaves(grads)))
+                scale = jnp.minimum(1.0, grad_clip / (gnorm + 1e-12))
+                grads = jax.tree.map(lambda g: g * scale, grads)
 
         # --- sparse allreduce of the gradient: one collective per
         # reverse-layer-order bucket. num_buckets == 1 degenerates to the
@@ -337,7 +341,11 @@ def build_sparse_grad_step(
             if momentum_correction:
                 flat = momentum_correction * moms_in[bi][0] + flat
                 new_moms.append(flat[None])
-            reduced, sp_new = algos[bi](flat, sp, cfg_b, axis_name)
+            # bucket container scope: the collective's own phase scopes
+            # nest inside it, so trace names carry the bucket id even for
+            # algorithms annotated without one
+            with phase_scope(bucket=bi):
+                reduced, sp_new = algos[bi](flat, sp, cfg_b, axis_name)
             if has_quality:
                 # fidelity tap (obs/quality.py): reference is the dense
                 # gradient the selection approximated — exactly what this
@@ -390,9 +398,10 @@ def build_sparse_grad_step(
                if profile_norm else None)
 
         # --- optimizer update (identical on every worker) ---
-        updates, opt_state = optimizer.update(grads, state.opt_state,
-                                              state.params)
-        params = jax.tree.map(jnp.add, state.params, updates)
+        with phase_scope("optimizer"):
+            updates, opt_state = optimizer.update(grads, state.opt_state,
+                                                  state.params)
+            params = jax.tree.map(jnp.add, state.params, updates)
 
         metrics = {
             "loss": lax.pmean(loss, axis_name),

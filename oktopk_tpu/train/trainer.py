@@ -166,7 +166,8 @@ class Trainer:
                 from oktopk_tpu.obs.regress import RegressionDetector
                 self.regress = RegressionDetector.from_bench_records(
                     key=cfg.obs_regress_key, bus=self.bus,
-                    tolerance=cfg.obs_regress_tolerance)
+                    tolerance=cfg.obs_regress_tolerance,
+                    phase_limits=cfg.obs_phase_limits)
 
         # ---- numeric-health guard + supervisor (resilience/) ----------
         self._fault_plan = fault_plan
@@ -731,8 +732,12 @@ class Trainer:
                             "elements", step, int(nf))
                     nf_window.clear()
                 if timers is not None and self.bus is not None:
-                    self.bus.emit("phase", step=step,
-                                  phases=timers.summary())
+                    phase_summary = timers.summary()
+                    self.bus.emit("phase", step=step, phases=phase_summary)
+                    if self.regress is not None:
+                        # host-phase durations vs configured phase limits
+                        # (key="phase:<name>" regressions on the bus)
+                        self.regress.observe_phases(step, phase_summary)
                 t0 = time.time()
             if timers is not None and logger is not None:
                 timers.maybe_log(step, logger)

@@ -80,13 +80,32 @@ class PhaseTimers:
 
     def summary(self) -> Dict[str, Dict[str, float]]:
         """Machine-readable form of :meth:`table` (for the run
-        journal's ``phase`` events)."""
+        journal's ``phase`` events): mean/min/max and nearest-rank
+        p50/p95 per phase, so host-phase spread sits next to the device
+        anatomy in one report (scripts/obs_report.py)."""
         out: Dict[str, Dict[str, float]] = {}
         for name, s in self._samples.items():
+            if not s:
+                out[name] = {"mean_ms": 0.0, "min_ms": 0.0, "max_ms": 0.0,
+                             "p50_ms": 0.0, "p95_ms": 0.0,
+                             "total_s": 0.0, "count": 0.0}
+                continue
+            srt = sorted(s)
+            cnt = len(srt)
+
+            def rank(q: float) -> float:
+                # nearest-rank percentile: exact order statistic, no
+                # interpolation inventing never-observed durations
+                return srt[min(cnt - 1, max(0, int(q * cnt + 0.5) - 1))]
+
             out[name] = {
-                "mean_ms": (sum(s) / len(s) * 1e3) if s else 0.0,
+                "mean_ms": sum(s) / cnt * 1e3,
+                "min_ms": srt[0] * 1e3,
+                "max_ms": srt[-1] * 1e3,
+                "p50_ms": rank(0.50) * 1e3,
+                "p95_ms": rank(0.95) * 1e3,
                 "total_s": float(sum(s)),
-                "count": float(len(s)),
+                "count": float(cnt),
             }
         return out
 

@@ -16,6 +16,10 @@ Sections (each omitted when the journal has no matching events):
   error, residual growth, effective density, churn) + breach counts
 - autotune decision log (per-bucket chosen algorithm + reason)
 - host phase table (latest ``phase`` event)
+- step anatomy: per-bucket phase waterfall from the latest
+  ``step_anatomy`` events plus the overlap scorecard (measured step vs
+  the fully-overlapped lower bound ``max(compute, comm)``) from
+  ``overlap_report``
 - incident timeline: faults, guard trips, fallbacks, restores,
   checkpoints (including durable-plane saves, verification failures and
   verified restores), trace captures, regressions, remeshes, forced
@@ -23,7 +27,8 @@ Sections (each omitted when the journal has no matching events):
   quality rollups in step order
 
 Exit codes (``ckpt_fsck.py`` discipline): 0 clean; with ``--strict``,
-1 on schema violations or breach-flagged quality rollups; 2 when the
+1 on schema violations, breach-flagged quality rollups, or phase-limit
+breaches (``regression`` events with ``key="phase:..."``); 2 when the
 journal cannot be read at all.
 
 Works on any JSONL journal that validates against
@@ -184,6 +189,66 @@ def _phase_lines(entries: List[Dict[str, Any]]) -> List[str]:
     return out
 
 
+def _anatomy_lines(entries: List[Dict[str, Any]]) -> List[str]:
+    """"Step anatomy" waterfall (latest capture, per-bucket phase bars)
+    plus the overlap scorecard: measured step vs the fully-overlapped
+    lower bound max(compute, comm)."""
+    anat = [e for e in entries if e.get("event") == "step_anatomy"]
+    overlap = [e for e in entries if e.get("event") == "overlap_report"]
+    if not anat and not overlap:
+        return []
+    latest: Dict[int, Dict[str, Any]] = {}
+    for e in anat:
+        latest[int(e.get("bucket", 0))] = e
+    src = next((e.get("source") for e in reversed(anat + overlap)
+                if e.get("source")), "?")
+    step = (anat or overlap)[-1].get("step", "?")
+    out = [f"step anatomy (source {src}, step {step}):"]
+    peak = 0.0
+    for e in latest.values():
+        for d in (e.get("phases") or {}).values():
+            v = d.get("ms") if isinstance(d, dict) else d
+            if isinstance(v, (int, float)):
+                peak = max(peak, float(v))
+    for b in sorted(latest):
+        label = "model-level" if b < 0 else f"bucket {b}"
+        out.append(f"  {label}:")
+        phases = latest[b].get("phases") or {}
+        for name in sorted(phases):
+            d = phases[name] if isinstance(phases[name], dict) else {}
+            v = d.get("ms", phases[name])
+            if not isinstance(v, (int, float)):
+                continue
+            bar = "#" * max(1, round(float(v) / peak * 28)) if peak else ""
+            out.append(f"    {name:<12}{float(v):>10.3f}ms "
+                       f"[{d.get('lane', 'compute'):<10}] {bar}")
+    if overlap:
+        o = overlap[-1]
+        out.append("overlap scorecard:")
+        out.append(
+            f"  compute {_fmt_q(o.get('compute_ms'), '.3f')}ms  "
+            f"comm {_fmt_q(o.get('comm_ms'), '.3f')}ms  "
+            f"overlap {_fmt_q(o.get('overlap_ms'), '.3f')}ms  "
+            f"(ratio {_fmt_q(o.get('overlap_ratio'), '.3f')})")
+        out.append(
+            f"  measured step {_fmt_q(o.get('step_ms'), '.3f')}ms vs "
+            f"ideal max(compute, comm) {_fmt_q(o.get('ideal_ms'), '.3f')}ms"
+            f"  (+{_fmt_q(o.get('serialization_ms'), '.3f')}ms "
+            "serialization)")
+        cp = o.get("critical_path")
+        if isinstance(cp, dict) and cp:
+            ranked = sorted(cp.items(), key=lambda kv: -float(kv[1]))
+            out.append("  critical path: " + "  ".join(
+                f"{k} {float(v):.3f}ms" for k, v in ranked))
+        if o.get("critical_phase"):
+            out.append(f"  critical phase: {o['critical_phase']}")
+    warns = [e for e in entries if e.get("event") == "anatomy_warning"]
+    for w in warns:
+        out.append(f"  WARNING: {w.get('reason')}"
+                   + (f" ({w.get('path')})" if w.get("path") else ""))
+    return out
+
+
 def _timeline_lines(entries: List[Dict[str, Any]]) -> List[str]:
     inc = [e for e in entries if e.get("event") in _INCIDENT_EVENTS
            and (e["event"] != "quality_rollup" or e.get("breaches"))]
@@ -258,7 +323,7 @@ def render_report(entries: List[Dict[str, Any]]) -> str:
     sections = [_header_lines(entries), _steps_lines(entries),
                 _volume_lines(entries), _quality_lines(entries),
                 _autotune_lines(entries), _phase_lines(entries),
-                _timeline_lines(entries)]
+                _anatomy_lines(entries), _timeline_lines(entries)]
     lines: List[str] = ["== run journal report =="]
     for sec in sections:
         if sec:
@@ -284,7 +349,11 @@ def report_json(entries: List[Dict[str, Any]]) -> Dict[str, Any]:
     rollups = [e for e in entries if e.get("event") == "quality_rollup"]
     breached = [e for e in rollups if e.get("breaches")]
     problems = validate_journal(entries)
-    return {
+    anat = [e for e in entries if e.get("event") == "step_anatomy"]
+    overlap = [e for e in entries if e.get("event") == "overlap_report"]
+    phase_breaches = [e for e in entries if e.get("event") == "regression"
+                      and str(e.get("key", "")).startswith("phase:")]
+    out = {
         "entries": len(entries),
         "events": counts,
         "schema_problems": list(problems),
@@ -297,6 +366,22 @@ def report_json(entries: List[Dict[str, Any]]) -> Dict[str, Any]:
                          for e in breached],
         },
     }
+    if anat or overlap or phase_breaches:
+        o = overlap[-1] if overlap else {}
+        out["anatomy"] = {
+            "buckets": sorted({int(e.get("bucket", 0)) for e in anat}),
+            "overlap_ratio": o.get("overlap_ratio"),
+            "step_ms": o.get("step_ms"),
+            "ideal_ms": o.get("ideal_ms"),
+            "serialization_ms": o.get("serialization_ms"),
+            "critical_phase": o.get("critical_phase"),
+            "source": o.get("source"),
+            "phase_breaches": [{"step": e.get("step"), "key": e.get("key"),
+                                "ms": e.get("ms"),
+                                "limit_ms": e.get("baseline_ms")}
+                               for e in phase_breaches],
+        }
+    return out
 
 
 def main(argv=None) -> int:
@@ -331,7 +416,8 @@ def main(argv=None) -> int:
         from oktopk_tpu.obs.export import write_textfile
         write_textfile(entries, args.prom)
     if args.strict and (summary["schema_problems"]
-                        or summary["quality"]["breached_rollups"]):
+                        or summary["quality"]["breached_rollups"]
+                        or summary.get("anatomy", {}).get("phase_breaches")):
         return 1
     return 0
 

@@ -42,13 +42,88 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
-def _med_ms(fn, sync, iters):
+def _med_ms(fn, sync, iters, timers=None, name=None):
     ts = []
     for _ in range(iters):
         t0 = time.perf_counter()
         sync(fn())
-        ts.append((time.perf_counter() - t0) * 1e3)
+        dt = time.perf_counter() - t0
+        ts.append(dt * 1e3)
+        if timers is not None and name:
+            timers.add(name, dt)
     return statistics.median(ts)
+
+
+def _parse_phase_limits(specs):
+    """--phase-limit exchange=50 [--phase-limit select=120 ...]"""
+    limits = {}
+    for spec in specs or []:
+        name, _, val = spec.partition("=")
+        if not name or not val:
+            raise SystemExit(f"--phase-limit wants PHASE=MS, got {spec!r}")
+        limits[name.strip()] = float(val)
+    return limits
+
+
+def _anatomy_main(args):
+    """--anatomy mode: capture one step anatomy on an emulated mesh,
+    journal step_anatomy/overlap_report events, check phase limits."""
+    # must precede `import jax`: the emulated multi-worker CPU mesh
+    # exists only if XLA is told before backend init
+    plat = args.platform or os.environ.get("JAX_PLATFORMS", "") or "cpu"
+    if ("cpu" in plat and args.anatomy_workers > 1
+            and "xla_force_host_platform_device_count"
+            not in os.environ.get("XLA_FLAGS", "")):
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + f" --xla_force_host_platform_device_count"
+              f"={args.anatomy_workers}").strip()
+    import tempfile
+
+    import jax
+    if args.platform:
+        jax.config.update("jax_platforms", args.platform)
+
+    from oktopk_tpu.comm.mesh import get_mesh
+    from oktopk_tpu.config import OkTopkConfig
+    from oktopk_tpu.obs.anatomy import capture_pipeline_anatomy, \
+        phase_totals
+    from oktopk_tpu.obs.journal import EventBus, RunJournal
+    from oktopk_tpu.obs.regress import RegressionDetector
+
+    devs = jax.devices()
+    P = min(args.anatomy_workers, len(devs))
+    mesh = get_mesh((P,), ("data",), devices=devs[:P])
+    cfg = OkTopkConfig(n=args.anatomy_n, num_workers=P,
+                       density=args.density, warmup_steps=0)
+    bus = EventBus()
+    RunJournal(args.anatomy_journal, bus)
+    logdir = args.anatomy_logdir or tempfile.mkdtemp(
+        prefix="oktopk_anatomy_")
+    analysis = capture_pipeline_anatomy(
+        cfg, mesh, logdir, num_buckets=args.anatomy_buckets,
+        iters=max(2, min(args.iters, 5)), bus=bus, step=0)
+
+    out = {"journal": args.anatomy_journal, "logdir": logdir,
+           "workers": P, "buckets": args.anatomy_buckets}
+    limits = _parse_phase_limits(args.phase_limit)
+    if analysis is None:
+        out["anatomy_unavailable"] = "profiler capture failed"
+    else:
+        out.update({k2: analysis[k2] for k2 in
+                    ("compute_ms", "comm_ms", "overlap_ms",
+                     "overlap_ratio", "step_ms", "ideal_ms",
+                     "serialization_ms", "critical_phase")})
+        out["phase_totals_ms"] = phase_totals(analysis)
+        if limits:
+            det = RegressionDetector(None, bus=bus, phase_limits=limits)
+            breaches = det.observe_phases(0, out["phase_totals_ms"])
+            out["phase_breaches"] = [b["key"] for b in breaches]
+    print("ANATOMY " + json.dumps(out))
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(out, f, indent=2)
+            f.write("\n")
 
 
 def main():
@@ -68,7 +143,28 @@ def main():
     ap.add_argument("--json", default=None, metavar="PATH",
                     help="also write the profile dict to PATH as JSON "
                          "(machine-readable; feedable to obs/regress.py)")
+    ap.add_argument("--anatomy", action="store_true",
+                    help="capture + analyze + journal a step anatomy "
+                         "(obs/anatomy.py) instead of the subprogram "
+                         "breakdown")
+    ap.add_argument("--anatomy-journal", default="anatomy_journal.jsonl",
+                    metavar="PATH", help="run-journal JSONL for --anatomy")
+    ap.add_argument("--anatomy-buckets", type=int, default=4)
+    ap.add_argument("--anatomy-workers", type=int, default=8,
+                    help="emulated mesh width for --anatomy (forces "
+                         "host-platform device count on CPU)")
+    ap.add_argument("--anatomy-n", type=int, default=1 << 18,
+                    help="flat gradient length for the --anatomy probes")
+    ap.add_argument("--anatomy-logdir", default=None,
+                    help="profiler capture dir (default: fresh tempdir)")
+    ap.add_argument("--phase-limit", action="append", default=[],
+                    metavar="PHASE=MS",
+                    help="journal a regression when a phase-family total "
+                         "exceeds MS (repeatable; --anatomy mode)")
     args = ap.parse_args()
+
+    if args.anatomy:
+        return _anatomy_main(args)
 
     import jax
     if args.platform:
@@ -99,6 +195,16 @@ def main():
     def sync(x):
         jax.tree.map(lambda a: np.asarray(a), x)
 
+    # host-phase stats ride along: every timed sample also lands in a
+    # PhaseTimers so --json carries count/min/max/p50/p95 per probe,
+    # comparable against the device anatomy in scripts/obs_report.py
+    from oktopk_tpu.utils.profiling import PhaseTimers
+    timers = PhaseTimers(every=0)
+
+    def med(fn, key):
+        return _med_ms(fn, sync, args.iters, timers=timers,
+                       name=key[:-3] if key.endswith("_ms") else key)
+
     out = {"device": dev.platform, "iters": args.iters}
 
     # --- full fused train step + fwd/bwd-only (dense optimizer ~ compute)
@@ -110,7 +216,7 @@ def main():
         tr = Trainer(cfg, mesh=mesh, warmup=False)
         fn = lambda tr=tr: tr.train_step(batch)
         _med_ms(fn, sync, 2)
-        out[key] = _med_ms(fn, sync, args.iters)
+        out[key] = med(fn, key)
         n = tr.algo_cfg.n
 
     # --- isolated sparse-allreduce on a same-sized gradient
@@ -143,12 +249,12 @@ def main():
                 "hist": build_allreduce_step("oktopk", hcfg, mesh,
                                              warmup=False)}
     state = _steady(acfg)
-    out["select_ms"] = _med_ms(lambda: step(g, state)[0], sync, args.iters)
+    out["select_ms"] = med(lambda: step(g, state)[0], "select_ms")
 
     # --- the same allreduce under the one-pass histogram threshold
     hstate = _steady(hcfg)
-    out["select_hist_ms"] = _med_ms(
-        lambda: step_fns["hist"](g, hstate)[0], sync, args.iters)
+    out["select_hist_ms"] = med(
+        lambda: step_fns["hist"](g, hstate)[0], "select_hist_ms")
 
     # --- components: exact threshold (bisect + hist), and the pack
     k = acfg.k
@@ -157,17 +263,17 @@ def main():
                                                   acfg.threshold_method,
                                                   acfg.bisect_iters))
     sync(thr_fn(gf))
-    out["threshold_ms"] = _med_ms(lambda: thr_fn(gf), sync, args.iters)
+    out["threshold_ms"] = med(lambda: thr_fn(gf), "threshold_ms")
     t = thr_fn(gf)
 
     hist_fn = jax.jit(lambda x: k2threshold_hist(jnp.abs(x), k))
     sync(hist_fn(gf))
-    out["hist_ms"] = _med_ms(lambda: hist_fn(gf), sync, args.iters)
+    out["hist_ms"] = med(lambda: hist_fn(gf), "hist_ms")
 
     pk = jax.jit(lambda x: select_by_threshold(
         x, t, acfg.cap_gather, use_pallas=bool(acfg.use_pallas)))
     sync(pk(gf))
-    out["pack_ms"] = _med_ms(lambda: pk(gf), sync, args.iters)
+    out["pack_ms"] = med(lambda: pk(gf), "pack_ms")
 
     # --- the fused single-sweep front-end (acc + stage + counts + hist).
     # The Pallas interpreter at real n is minutes-slow, so off-TPU the
@@ -186,9 +292,12 @@ def main():
             x, r, t, tp, bnd, 1, acfg.cap_pair))
         out["fused_select_backend"] = "reference"
     sync(fs(gf, res))
-    out["fused_select_ms"] = _med_ms(lambda: fs(gf, res), sync, args.iters)
+    out["fused_select_ms"] = med(lambda: fs(gf, res), "fused_select_ms")
     out["threshold_method"] = acfg.threshold_method
 
+    out["host_phases"] = {
+        name: {k3: round(v3, 4) for k3, v3 in stats.items()}
+        for name, stats in timers.summary().items()}
     out = {k2: (round(v, 3) if isinstance(v, float) else v)
            for k2, v in out.items()}
     print("PROFILE " + json.dumps(out))
