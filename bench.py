@@ -360,6 +360,48 @@ def step_time_probe(iters=10):
         print(f"[bench] oktopk_autotuned probe failed: {e!r}",
               file=sys.stderr)
 
+    # hierarchical two-level probe (collectives/hierarchical.py): dense
+    # intra-pod psum + oktopk across pods over a (pod, data) mesh built
+    # from ALL visible devices. Needs >= 2 pods' worth of devices —
+    # single-chip runs degrade gracefully (the record simply lacks
+    # hierarchical_ms, like any killed tail probe). Raw collective step,
+    # not a Trainer: the point is the two-level exchange price next to
+    # the flat numbers above, on the same record.
+    try:
+        from oktopk_tpu.collectives.api import (batched_init_state,
+                                                build_allreduce_step,
+                                                time_allreduce_step)
+        from oktopk_tpu.collectives.hierarchical import \
+            make_hierarchical_config
+        from oktopk_tpu.comm.mesh import local_hierarchical_mesh
+        from oktopk_tpu.config import OkTopkConfig
+
+        ndev = len(jax.devices())
+        if ndev < 2:
+            raise RuntimeError(f"needs >= 2 devices for 2 pods, have {ndev}")
+        hmesh = local_hierarchical_mesh(num_pods=2)
+        total = hmesh.devices.size
+        n = 1 << 18
+        flat = OkTopkConfig(n=n, num_workers=total, density=0.02,
+                            warmup_steps=0)
+        hcfg = make_hierarchical_config(flat, num_pods=2, outer="oktopk")
+        hstep = build_allreduce_step("hierarchical", hcfg, hmesh)
+        grads = jax.device_put(
+            np.asarray(rng.standard_normal((total, n)), np.float32),
+            jax.sharding.NamedSharding(
+                hmesh, jax.sharding.PartitionSpec(
+                    (hcfg.inter_axis, hcfg.intra_axis))))
+        hst = batched_init_state(hcfg)
+        ms, _ = time_allreduce_step(hstep, grads, hst, iters=iters)
+        out["hierarchical_ms"] = statistics.median(ms)
+        out["hierarchical_ms_std"] = statistics.pstdev(ms)
+        out["hierarchical_plan"] = {"num_pods": hcfg.num_pods,
+                                    "pod_size": hcfg.pod_size,
+                                    "levels": hcfg.level_plan()}
+        print("STEP_PROBE " + json.dumps(out), flush=True)
+    except Exception as e:
+        print(f"[bench] hierarchical probe failed: {e!r}", file=sys.stderr)
+
     # numeric-health tail (resilience/): a few guarded oktopk steps so the
     # bench driver tracks numeric health alongside latency — steps_skipped
     # and fallback_events must be 0 on a healthy chip, and grad_nonfinite
@@ -469,6 +511,8 @@ def main():
                     "oktopk_b4_ms", "oktopk_b4_ms_std",
                     "oktopk_autotuned_ms", "oktopk_autotuned_ms_std",
                     "autotune_plan",
+                    "hierarchical_ms", "hierarchical_ms_std",
+                    "hierarchical_plan",
                     "dense_bf16_ms", "dense_bf16_ms_std",
                     "dense_bf16_bs256_ms", "dense_bf16_bs256_ms_std",
                     "oktopk_pallas_failed", "oktopk_bs256_pallas_failed",

@@ -25,11 +25,15 @@ events carry ``event`` and ``step``:
                    "predicted_ms": 3.1, "measured_ms": 2.9}, ...],
    "chosen": {"algo": "oktopk", "density": 0.02},
    "incumbent": {"algo": "dense", "density": 1.0} | null,
-   "reason": "trial" | "hold"}
+   "reason": "trial" | "hold" | "plan"}
 
 ``reason`` is "hold" when hysteresis kept the incumbent despite a
 challenger measuring faster (within the hysteresis margin), "trial"
-otherwise.
+otherwise — or "plan" when the tuner ran in fabric-preset plan mode
+(no trials; the cost-model prior stood in for the posterior). Plan-mode
+decisions additionally carry ``fabric`` and ``num_pods``, and
+hierarchical candidates/chosen carry ``outer`` plus a ``levels`` list of
+per-level (algorithm, density).
 """
 
 from __future__ import annotations

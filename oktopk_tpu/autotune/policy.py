@@ -19,6 +19,8 @@ from typing import Callable, List, Optional, Sequence, Tuple
 from oktopk_tpu.autotune.calibrate import (FabricCoefficients,
                                            default_coefficients)
 from oktopk_tpu.autotune.journal import DecisionJournal
+from oktopk_tpu.comm.fabric import (PLAN_SELECT_GAMMA, TwoLevelFabric,
+                                    resolve_two_level)
 from oktopk_tpu.utils.cost_model import (allgather_cost, allreduce_cost,
                                          sparse_allreduce_cost, topk_cost)
 
@@ -33,16 +35,32 @@ _ALLGATHER_FAMILY = ("topkA", "topkA2", "topkAopt", "gtopk", "gaussiank",
 @dataclasses.dataclass(frozen=True)
 class Candidate:
     """One (algorithm, density) point in the search space. ``density`` is
-    1.0 for dense (ignored by the algorithm, kept for the journal)."""
+    1.0 for dense (ignored by the algorithm, kept for the journal).
+
+    ``algo="hierarchical"`` names the two-level composition
+    (collectives/hierarchical.py): dense intra-pod plus ``outer`` (a flat
+    registry algorithm) across pods at ``density``. Hierarchical
+    candidates are priced by the per-level fabric model and require the
+    tuner's ``fabric``/``num_pods`` plan-mode inputs."""
 
     algo: str
     density: float = 1.0
+    outer: Optional[str] = None     # hierarchical only: inter-level algo
 
-    def key(self) -> Tuple[str, float]:
-        return (self.algo, self.density)
+    def key(self) -> Tuple[str, float, Optional[str]]:
+        return (self.algo, self.density, self.outer)
 
     def as_dict(self):
-        return {"algo": self.algo, "density": self.density}
+        d = {"algo": self.algo, "density": self.density}
+        if self.algo == "hierarchical":
+            out = self.outer or "oktopk"
+            d["outer"] = out
+            # the per-level (algorithm, density) plan the journal carries
+            d["levels"] = [
+                {"level": "intra", "algo": "dense", "density": 1.0},
+                {"level": "inter", "algo": out, "density": self.density},
+            ]
+        return d
 
 
 @dataclasses.dataclass(frozen=True)
@@ -55,16 +73,21 @@ class BucketPlan:
     density: float
     predicted_ms: float          # cost-model prior of the chosen candidate
     measured_ms: float           # trial posterior of the chosen candidate
+    outer: Optional[str] = None  # hierarchical plans: inter-level algo
 
-    def key(self) -> Tuple[str, float]:
-        return (self.algo, self.density)
+    def key(self) -> Tuple[str, float, Optional[str]]:
+        return (self.algo, self.density, self.outer)
 
     def as_dict(self):
         return dataclasses.asdict(self)
 
 
 def predict_ms(algo: str, density: float, n: int, num_workers: int,
-               coeffs: FabricCoefficients) -> float:
+               coeffs: FabricCoefficients, *,
+               fabric: Optional[TwoLevelFabric] = None,
+               num_pods: Optional[int] = None,
+               outer: Optional[str] = None,
+               select_gamma: Optional[float] = None) -> float:
     """α-β cost-model prior for one candidate, in milliseconds.
 
     dense: ring allreduce of n elements. oktopk: local selection +
@@ -73,9 +96,35 @@ def predict_ms(algo: str, density: float, n: int, num_workers: int,
     winners. Selection cost uses the sort-free γ·n estimate shared by all
     sparse candidates — the model only needs to rank, the trial phase
     measures.
+
+    ``algo="hierarchical"`` prices the two-level composition per level
+    with a :class:`~oktopk_tpu.comm.fabric.TwoLevelFabric`: a dense ring
+    allreduce of the pod (``num_workers / num_pods`` members) on the
+    intra fabric, plus the flat ``outer`` candidate at ``density`` among
+    ``num_pods`` leaders on the inter fabric. When a ``fabric`` is given
+    (preset planning, no measured chip), selection is priced with
+    ``select_gamma`` — defaulting to ``PLAN_SELECT_GAMMA``, the HBM-class
+    element-pass rate — uniformly across candidates so flat and
+    hierarchical compete on the same scale.
     """
     a, b = coeffs.alpha, coeffs.beta
     p = max(1, num_workers)
+    if select_gamma is None and fabric is not None:
+        select_gamma = PLAN_SELECT_GAMMA
+    if algo == "hierarchical":
+        if fabric is None or num_pods is None:
+            raise ValueError(
+                "hierarchical candidate needs fabric=TwoLevelFabric and "
+                "num_pods (per-level pricing has no single-coeffs form)")
+        two = resolve_two_level(fabric)
+        pods = max(1, int(num_pods))
+        pod = max(1, p // pods)
+        t_intra = (allreduce_cost(n, pod, two.intra.alpha_s,
+                                  two.intra.beta_elem()) * 1e3
+                   if pod > 1 else 0.0)
+        return t_intra + predict_ms(outer or "oktopk", density, n, pods,
+                                    two.inter.coefficients(),
+                                    select_gamma=select_gamma)
     if algo == "dense":
         if p == 1:
             # same degenerate (1, n) law the P=1 calibration fits: alpha
@@ -86,7 +135,7 @@ def predict_ms(algo: str, density: float, n: int, num_workers: int,
             return (ca * a + cb * b) * 1e3
         return allreduce_cost(n, p, a, b) * 1e3
     k = max(1, int(density * n))
-    sel = topk_cost(n)
+    sel = topk_cost(n) if select_gamma is None else topk_cost(n, select_gamma)
     if algo == "oktopk":
         return (sel + sparse_allreduce_cost(k, p, a, b)) * 1e3
     if algo in _ALLGATHER_FAMILY:
@@ -114,13 +163,29 @@ class AutotunePolicy:
 
     def decide(self, bucket: int, n: int, num_workers: int,
                coeffs: FabricCoefficients,
-               measure: Callable[[str, int, float], float],
+               measure: Optional[Callable[[str, int, float], float]],
                incumbent: Optional[BucketPlan] = None,
                journal: Optional[DecisionJournal] = None,
-               step: int = 0) -> BucketPlan:
-        """Pick the plan for one bucket; journals the full evidence."""
-        scored = [(predict_ms(c.algo, c.density, n, num_workers, coeffs), c)
-                  for c in self.candidates]
+               step: int = 0,
+               fabric: Optional[TwoLevelFabric] = None,
+               num_pods: Optional[int] = None,
+               select_gamma: Optional[float] = None) -> BucketPlan:
+        """Pick the plan for one bucket; journals the full evidence.
+
+        ``measure=None`` is PLAN mode: no trial runs, the cost-model
+        prior stands in for the posterior (reason ``"plan"``) — used
+        when planning for a target (P, fabric) the current chips cannot
+        measure. Hierarchical candidates are always model-priced (a
+        flat trial mesh cannot run the two-level composition)."""
+        if fabric is not None:
+            fabric = resolve_two_level(fabric)
+
+        def _predict(c: Candidate) -> float:
+            return predict_ms(c.algo, c.density, n, num_workers, coeffs,
+                              fabric=fabric, num_pods=num_pods,
+                              outer=c.outer, select_gamma=select_gamma)
+
+        scored = [(_predict(c), c) for c in self.candidates]
         scored.sort(key=lambda pc: pc[0])
         trialed = scored
         if self.max_trials > 0:
@@ -131,22 +196,27 @@ class AutotunePolicy:
                     c.key() == incumbent.key() for _, c in trialed):
                 trialed = trialed + [
                     (p, c) for p, c in scored if c.key() == incumbent.key()]
-        rows = [{"algo": c.algo, "density": c.density,
-                 "predicted_ms": pred,
-                 "measured_ms": measure(c.algo, n, c.density)}
+
+        def _posterior(pred: float, c: Candidate) -> float:
+            if measure is None or c.algo == "hierarchical":
+                return pred
+            return measure(c.algo, n, c.density)
+
+        rows = [{**c.as_dict(), "predicted_ms": pred,
+                 "measured_ms": _posterior(pred, c)}
                 for pred, c in trialed]
-        skipped = [{"algo": c.algo, "density": c.density,
-                    "predicted_ms": pred, "measured_ms": None}
+        trialed_keys = {c.key() for _, c in trialed}
+        skipped = [{**c.as_dict(), "predicted_ms": pred, "measured_ms": None}
                    for pred, c in scored[len(trialed):]
-                   if not any(r["algo"] == c.algo
-                              and r["density"] == c.density for r in rows)]
+                   if c.key() not in trialed_keys]
         best = min(rows, key=lambda r: r["measured_ms"])
-        reason = "trial"
+        reason = "plan" if measure is None else "trial"
         chosen = best
         if incumbent is not None:
-            inc_fresh = next((r for r in rows
-                              if (r["algo"], r["density"]) ==
-                              incumbent.key()), None)
+            inc_fresh = next(
+                (r for r in rows
+                 if (r["algo"], r["density"], r.get("outer")) ==
+                 incumbent.key()), None)
             if inc_fresh is not None and (
                     best["measured_ms"]
                     >= inc_fresh["measured_ms"] * (1.0 - self.hysteresis)):
@@ -154,23 +224,35 @@ class AutotunePolicy:
         plan = BucketPlan(bucket=bucket, n=n, algo=chosen["algo"],
                           density=chosen["density"],
                           predicted_ms=chosen["predicted_ms"],
-                          measured_ms=chosen["measured_ms"])
+                          measured_ms=chosen["measured_ms"],
+                          outer=chosen.get("outer"))
         if journal is not None:
+            chosen_dict = {k: chosen[k]
+                           for k in ("algo", "density", "outer", "levels")
+                           if k in chosen}
             journal.record(
                 "decision", step=step, bucket=bucket, n=n,
                 num_workers=num_workers, candidates=rows + skipped,
-                chosen={"algo": plan.algo, "density": plan.density},
+                chosen=chosen_dict,
                 incumbent=(None if incumbent is None else
                            {"algo": incumbent.algo,
-                            "density": incumbent.density}),
-                reason=reason)
+                            "density": incumbent.density,
+                            **({"outer": incumbent.outer}
+                               if incumbent.outer else {})}),
+                reason=reason,
+                **({"fabric": fabric.name, "num_pods": int(num_pods or 1)}
+                   if fabric is not None else {}))
         return plan
 
 
 def make_candidates(algos: Sequence[str],
-                    densities: Sequence[float]) -> Tuple[Candidate, ...]:
+                    densities: Sequence[float],
+                    hierarchical_outers: Sequence[str] = ()
+                    ) -> Tuple[Candidate, ...]:
     """Cross sparse algorithms with the density grid; dense gets the single
-    density-1.0 point."""
+    density-1.0 point. ``hierarchical_outers`` adds two-level candidates —
+    one per (outer algorithm, density) pair — for plan-mode tuners that
+    carry a ``fabric``/``num_pods`` target."""
     out: List[Candidate] = []
     for a in algos:
         if a == "dense":
@@ -178,6 +260,12 @@ def make_candidates(algos: Sequence[str],
         else:
             for d in densities:
                 out.append(Candidate(a, float(d)))
+    for o in hierarchical_outers:
+        if o == "dense":
+            out.append(Candidate("hierarchical", 1.0, outer="dense"))
+        else:
+            for d in densities:
+                out.append(Candidate("hierarchical", float(d), outer=o))
     return tuple(out)
 
 
@@ -190,13 +278,23 @@ class Autotuner:
     current plan list; the trainer consults ``plans`` when (re)building
     its step and calls ``should_retune``/``tune`` on the configured
     cadence.
+
+    ``fabric`` switches the tuner to PLAN mode: a named fabric preset
+    (``"dcn"``), a :class:`~oktopk_tpu.comm.fabric.FabricPreset`, or a
+    :class:`~oktopk_tpu.comm.fabric.TwoLevelFabric` describing the
+    TARGET deployment rather than the chips underfoot. Calibration then
+    takes α-β from the preset's inter edge (no probing), trials are
+    skipped (``measure=None`` — the prior stands), and hierarchical
+    candidates become priceable (``num_pods`` splits ``num_workers``
+    into pods). ``runner`` may be ``None`` in plan mode.
     """
 
     def __init__(self, bucket_sizes: Sequence[int], num_workers: int,
                  policy: AutotunePolicy, runner,
                  coeffs: Optional[FabricCoefficients] = None,
                  journal: Optional[DecisionJournal] = None,
-                 calibration_sizes: Optional[Sequence[int]] = None):
+                 calibration_sizes: Optional[Sequence[int]] = None,
+                 fabric=None, num_pods: Optional[int] = None):
         self.bucket_sizes = [int(s) for s in bucket_sizes]
         self.num_workers = int(num_workers)
         self.policy = policy
@@ -204,16 +302,26 @@ class Autotuner:
         self.journal = journal if journal is not None else DecisionJournal()
         self.coeffs = coeffs
         self.calibration_sizes = calibration_sizes
+        self.fabric: Optional[TwoLevelFabric] = (
+            None if fabric is None else resolve_two_level(fabric))
+        self.num_pods = None if num_pods is None else int(num_pods)
+        if self.fabric is None and runner is None:
+            raise ValueError("Autotuner needs a trial runner unless a "
+                             "fabric preset puts it in plan mode")
         self.plans: Optional[List[BucketPlan]] = None
         self.last_tune_step: Optional[int] = None
 
     def calibrate(self, mesh=None, step: int = 0) -> FabricCoefficients:
         """Fit α-β from probe collectives (falls back to the cost-model
-        defaults when no mesh is available to probe)."""
+        defaults when no mesh is available to probe). In plan mode the
+        preset's inter-edge coefficients are used verbatim — the point is
+        to price a fabric the current chips cannot exhibit."""
         from oktopk_tpu.autotune.calibrate import (DEFAULT_PROBE_SIZES,
                                                    probe_fabric)
 
-        if mesh is not None:
+        if self.fabric is not None:
+            self.coeffs = self.fabric.inter.coefficients()
+        elif mesh is not None:
             sizes = tuple(self.calibration_sizes or DEFAULT_PROBE_SIZES)
             self.coeffs = probe_fabric(mesh, sizes=sizes)
         elif self.coeffs is None:
@@ -237,11 +345,15 @@ class Autotuner:
         if self.coeffs is None:
             self.calibrate(mesh=mesh, step=step)
         old = self.plans
+        plan_mode = self.fabric is not None
+        measure = None if plan_mode else self.runner.measure
         self.plans = [
             self.policy.decide(
-                bi, n, self.num_workers, self.coeffs, self.runner.measure,
+                bi, n, self.num_workers, self.coeffs, measure,
                 incumbent=(old[bi] if old is not None else None),
-                journal=self.journal, step=step)
+                journal=self.journal, step=step,
+                fabric=self.fabric, num_pods=self.num_pods,
+                select_gamma=PLAN_SELECT_GAMMA if plan_mode else None)
             for bi, n in enumerate(self.bucket_sizes)]
         self.last_tune_step = step
         return self.plans

@@ -16,28 +16,68 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
+from oktopk_tpu.collectives.hierarchical import HierarchicalConfig
 from oktopk_tpu.collectives.registry import get_algorithm
 from oktopk_tpu.collectives.state import SparseState, init_state
 from oktopk_tpu.comm import compat
 from oktopk_tpu.config import OkTopkConfig
 
 
-def batched_init_state(cfg: OkTopkConfig, dtype=jnp.float32) -> SparseState:
+def batched_init_state(cfg, dtype=jnp.float32) -> SparseState:
     """Per-worker state stacked on a leading device axis [P, ...] so it can be
     sharded over the data axis (each worker owns its residual/thresholds,
-    as each rank does in the reference)."""
-    s = init_state(cfg, dtype)
+    as each rank does in the reference).
+
+    A :class:`HierarchicalConfig` is accepted too: the state is the OUTER
+    level's (residual/thresholds live among pod leaders only) replicated
+    across all ``num_pods * pod_size`` worker rows — each pod's members
+    carry identical copies, mirroring the leader-replication the
+    emulated exchange performs."""
+    base = cfg.outer_cfg if isinstance(cfg, HierarchicalConfig) else cfg
+    s = init_state(base, dtype)
     return jax.tree.map(
         lambda x: jnp.broadcast_to(x, (cfg.num_workers,) + x.shape), s)
 
 
-def build_allreduce_step(name: str, cfg: OkTopkConfig, mesh: Mesh,
+def _hierarchical_setup(name: str, cfg, mesh, warmup: bool):
+    """Shared validation/normalisation for the hierarchical build paths:
+    returns ``(cfg, spec)`` with pallas resolved on the outer config and
+    the shard spec covering (inter, intra) on the leading grad axis."""
+    from oktopk_tpu.ops.compaction import resolve_use_pallas
+    if name != "hierarchical":
+        raise ValueError(
+            f"config is a HierarchicalConfig but algorithm is {name!r}; "
+            "pass name='hierarchical' (outer algorithm goes in cfg.outer)")
+    if not isinstance(cfg, HierarchicalConfig):
+        raise TypeError(
+            f"build step for {name!r} needs a HierarchicalConfig "
+            "(collectives.hierarchical.make_hierarchical_config), got "
+            f"{type(cfg).__name__}")
+    for ax, want in ((cfg.inter_axis, cfg.num_pods),
+                     (cfg.intra_axis, cfg.pod_size)):
+        have = dict(zip(mesh.axis_names, mesh.devices.shape)).get(ax)
+        if have != want:
+            raise ValueError(
+                f"mesh axis {ax!r} has size {have}, config wants {want} "
+                f"(mesh axes {dict(zip(mesh.axis_names, mesh.devices.shape))})")
+    cfg = cfg.replace(outer_cfg=resolve_use_pallas(cfg.outer_cfg, mesh),
+                      outer_warmup=warmup)
+    return cfg, P((cfg.inter_axis, cfg.intra_axis))
+
+
+def build_allreduce_step(name: str, cfg, mesh: Mesh,
                          axis_name: str = "data", warmup: bool = True,
                          check_vma: bool = True, donate_state: bool = False):
     """jit-compiled ``(grads [P, n], state) -> (results [P, n], state)``.
 
     ``results`` is the same reduced vector replicated per worker row (every
     rank gets the full result, as after the reference's allgather phase).
+
+    ``cfg`` is an ``OkTopkConfig`` for the flat algorithms, or a
+    ``HierarchicalConfig`` with ``name="hierarchical"`` — then ``mesh``
+    must be two-level (comm.mesh.hierarchical_mesh) and grads'/state's
+    leading [P] axis is sharded over (inter, intra); ``axis_name`` is
+    ignored (both axes come from the config).
 
     ``check_vma=False`` disables shard_map's varying-axes tracking — needed
     when running the Pallas selection kernel through its interpreter on a
@@ -52,14 +92,20 @@ def build_allreduce_step(name: str, cfg: OkTopkConfig, mesh: Mesh,
     the train-loop pattern ``out, state = step(g, state)`` is safe.
     """
     from oktopk_tpu.ops.compaction import resolve_use_pallas
-    cfg = resolve_use_pallas(cfg, mesh)
-    algo = get_algorithm(name, warmup=warmup)
-    spec = P(axis_name)
+    if name == "hierarchical" or isinstance(cfg, HierarchicalConfig):
+        # two-level path: spec covers (inter, intra) on the leading grad
+        # axis; warmup is composed on the OUTER level (registry.py)
+        cfg, spec = _hierarchical_setup(name, cfg, mesh, warmup)
+        algo, axis_arg = get_algorithm("hierarchical", warmup=False), None
+    else:
+        cfg = resolve_use_pallas(cfg, mesh)
+        algo, axis_arg = get_algorithm(name, warmup=warmup), axis_name
+        spec = P(axis_name)
 
     def shard_fn(g, s):
         g1 = g[0]
         s1 = jax.tree.map(lambda x: x[0], s)
-        out, s2 = algo(g1, s1, cfg, axis_name)
+        out, s2 = algo(g1, s1, cfg, axis_arg)
         return out[None], jax.tree.map(lambda x: x[None], s2)
 
     mapped = compat.shard_map(shard_fn, mesh=mesh,
@@ -70,7 +116,7 @@ def build_allreduce_step(name: str, cfg: OkTopkConfig, mesh: Mesh,
     return jax.jit(mapped)
 
 
-def build_quality_allreduce_step(name: str, cfg: OkTopkConfig, mesh: Mesh,
+def build_quality_allreduce_step(name: str, cfg, mesh: Mesh,
                                  quality, axis_name: str = "data",
                                  warmup: bool = True,
                                  check_vma: bool = True):
@@ -88,17 +134,29 @@ def build_quality_allreduce_step(name: str, cfg: OkTopkConfig, mesh: Mesh,
     from oktopk_tpu.obs.quality import commit, measure_bucket
     from oktopk_tpu.ops.compaction import resolve_use_pallas
     from jax import lax
-    cfg = resolve_use_pallas(cfg, mesh)
-    algo = get_algorithm(name, warmup=warmup)
-    spec = P(axis_name)
+    hier = name == "hierarchical" or isinstance(cfg, HierarchicalConfig)
+    if hier:
+        cfg, spec = _hierarchical_setup(name, cfg, mesh, warmup)
+        algo, axis_arg = get_algorithm("hierarchical", warmup=False), None
+    else:
+        cfg = resolve_use_pallas(cfg, mesh)
+        algo, axis_arg = get_algorithm(name, warmup=warmup), axis_name
+        spec = P(axis_name)
     del quality  # static config lives in the buffer's shapes
 
     def shard_fn(g, s, q):
         g1 = g[0]
         s1 = jax.tree.map(lambda x: x[0], s)
         q1 = jax.tree.map(lambda x: x[0], q)
-        out, s2 = algo(g1, s1, cfg, axis_name)
-        dense = lax.pmean(g1 + s1.residual, axis_name)
+        out, s2 = algo(g1, s1, cfg, axis_arg)
+        if hier:
+            # the intra psum is lossless, so the fidelity oracle is the
+            # unchanged pre-selection dense gradient: the full-world mean
+            # of grad plus the (pod-level) error-feedback residual
+            dense = lax.pmean(
+                lax.pmean(g1, cfg.intra_axis) + s1.residual, cfg.inter_axis)
+        else:
+            dense = lax.pmean(g1 + s1.residual, axis_name)
         scalars = measure_bucket(out, dense, s2, q1.prev_sig,
                                  q1.prev_res_norm)
         q2 = commit(q1, s2.step, scalars, jnp.asarray(False))

@@ -1,0 +1,210 @@
+"""Two-level hierarchical sparse allreduce: dense intra-pod, sparse
+inter-pod.
+
+docs/PERF.md's crossover analysis shows sparse collectives only win
+where per-worker bandwidth collapses (DCN-spanning multi-pod data
+parallelism, ~2.1-2.4 GB/s/worker); inside a pod the 100 GB/s ICI ring
+makes dense psum the optimum. SparCML's hierarchical sparse-streaming
+allreduce over heterogeneous fabrics (arXiv 1802.08021) is the
+blueprint: reduce densely over the fast local links, run the sparse
+exchange only across the slow edge, broadcast the result back down.
+
+This module is a *composition over the registry*, not a tenth monolith:
+
+    hierarchical(grad) = broadcast_intra(outer_algo(pmean_intra(grad)))
+
+- **intra level (level 0)**: dense ``pmean`` over ``intra_axis`` — the
+  pod-mean gradient, lossless (so the quality oracle is unchanged:
+  comp_err still measures compression against the pre-selection dense
+  gradient).
+- **inter level (level 1)**: any registry algorithm (``outer``:
+  "dense", "oktopk", "topkA", ...) over ``inter_axis`` with
+  ``outer_cfg`` (``num_workers == num_pods``). All ``SparseState``
+  (residual, thresholds, wire accounting) lives here — the intra psum
+  has no error feedback to keep.
+- **broadcast**: free by construction under shard_map emulation — after
+  the intra pmean every pod member holds identical data, so every
+  member runs the identical inter exchange and already holds the
+  result. On a real two-fabric slice the inter collective would be
+  gated to one leader per pod and the result broadcast over ICI; the
+  wire accounting below prices that leader pattern (one inter exchange
+  per pod), which is also what each emulated member measures.
+
+Wire bytes are tracked PER LEVEL (``SparseState.wire_bytes_intra`` /
+``wire_bytes_inter``) so the DCN edge — the scarce resource — is priced
+separately; ``obs/volume.py`` holds each level against its own analytic
+budget (intra: dense ring 2n(P_pod-1)/P_pod values; inter: the outer
+algorithm's existing budget at P=num_pods).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax.numpy as jnp
+from jax import lax
+
+from oktopk_tpu.collectives.state import SparseState
+from oktopk_tpu.collectives.wire import dense_wire_bytes
+from oktopk_tpu.comm.mesh import DATA_AXIS, POD_AXIS
+from oktopk_tpu.comm.primitives import pvary_like
+from oktopk_tpu.config import OkTopkConfig
+from oktopk_tpu.obs.anatomy import phase_scope
+
+
+@dataclasses.dataclass(frozen=True)
+class HierarchicalConfig:
+    """Static configuration of the two-level composition.
+
+    Wraps the OUTER algorithm's :class:`OkTopkConfig` (``outer_cfg``,
+    with ``num_workers == num_pods`` and the inter-level density) plus
+    the topology and axis names. Hashable and static under jit, like
+    ``OkTopkConfig``. Build via :func:`make_hierarchical_config`, which
+    derives ``outer_cfg`` from a flat config by splitting the density
+    budget per level.
+    """
+
+    outer_cfg: OkTopkConfig
+    num_pods: int = 1
+    pod_size: int = 1
+    inner: str = "dense"            # intra-level algorithm (dense only)
+    outer: str = "oktopk"           # inter-level registry algorithm
+    inter_axis: str = POD_AXIS      # mesh axis crossing pods (slow edge)
+    intra_axis: str = DATA_AXIS     # mesh axis within a pod (fast edge)
+    outer_warmup: bool = True       # wrap the outer algo in dense warmup
+    # Share of the end-to-end density budget granted to the inter level.
+    # The intra psum is dense (lossless), so the full budget (1.0) goes
+    # to the inter exchange by default; < 1.0 reserves headroom.
+    density_split: float = 1.0
+
+    def __post_init__(self):
+        if self.num_pods < 1 or self.pod_size < 1:
+            raise ValueError("need num_pods >= 1 and pod_size >= 1, got "
+                             f"{self.num_pods}x{self.pod_size}")
+        if self.inner != "dense":
+            raise ValueError(
+                f"inner level supports only 'dense' (got {self.inner!r}); "
+                "the intra-pod fabric is where dense is already optimal")
+        if self.outer_cfg.num_workers != self.num_pods:
+            raise ValueError(
+                f"outer_cfg.num_workers ({self.outer_cfg.num_workers}) "
+                f"must equal num_pods ({self.num_pods})")
+        if self.inter_axis == self.intra_axis:
+            raise ValueError("inter_axis and intra_axis must differ, got "
+                             f"{self.inter_axis!r} twice")
+        if not 0.0 < self.density_split <= 1.0:
+            raise ValueError(
+                f"density_split must be in (0, 1], got {self.density_split}")
+
+    # Flat-config conveniences so generic machinery (batched_init_state,
+    # obs/volume.volume_report) can read the combined geometry.
+    @property
+    def n(self) -> int:
+        return self.outer_cfg.n
+
+    @property
+    def num_workers(self) -> int:
+        """Total world size across both levels."""
+        return self.num_pods * self.pod_size
+
+    @property
+    def density(self) -> float:
+        """End-to-end delivered density = the inter level's density
+        (the intra psum is lossless)."""
+        return self.outer_cfg.density
+
+    def replace(self, **kw) -> "HierarchicalConfig":
+        return dataclasses.replace(self, **kw)
+
+    def level_plan(self):
+        """The per-level (algorithm, density) plan — what autotune
+        decisions journal and bench records carry."""
+        return [
+            {"level": "intra", "algo": self.inner, "density": 1.0},
+            {"level": "inter", "algo": self.outer,
+             "density": self.outer_cfg.density},
+        ]
+
+
+def make_hierarchical_config(cfg: OkTopkConfig, num_pods: int,
+                             pod_size: Optional[int] = None, *,
+                             inner: str = "dense", outer: str = "oktopk",
+                             density_split: float = 1.0,
+                             inter_axis: str = POD_AXIS,
+                             intra_axis: str = DATA_AXIS,
+                             ) -> HierarchicalConfig:
+    """Derive a :class:`HierarchicalConfig` from a FLAT config.
+
+    ``cfg`` describes the flat world (``num_workers`` = total workers,
+    ``density`` = end-to-end budget); the outer config inherits every
+    algorithm knob but runs at ``num_workers=num_pods`` with
+    ``density * density_split`` (dense outer keeps density 1.0).
+    """
+    if pod_size is None:
+        if cfg.num_workers % num_pods:
+            raise ValueError(f"num_workers ({cfg.num_workers}) not "
+                             f"divisible by num_pods ({num_pods})")
+        pod_size = cfg.num_workers // num_pods
+    if num_pods * pod_size != cfg.num_workers:
+        raise ValueError(
+            f"num_pods*pod_size ({num_pods}x{pod_size}) must equal "
+            f"cfg.num_workers ({cfg.num_workers})")
+    outer_density = 1.0 if outer == "dense" else cfg.density * density_split
+    outer_cfg = cfg.replace(num_workers=num_pods, density=outer_density)
+    return HierarchicalConfig(outer_cfg=outer_cfg, num_pods=num_pods,
+                              pod_size=pod_size, inner=inner, outer=outer,
+                              inter_axis=inter_axis, intra_axis=intra_axis,
+                              density_split=density_split)
+
+
+def hierarchical(grad: jnp.ndarray, state: SparseState,
+                 cfg: HierarchicalConfig, axis_name: Optional[str] = None):
+    """The two-level collective body, shard_map'd over a (pod, data)
+    mesh by ``collectives/api.build_allreduce_step``.
+
+    ``axis_name`` is accepted for registry-signature compatibility and
+    must be None or ``cfg.inter_axis`` — the axes in play come from the
+    config (two of them, which the flat signature cannot carry).
+    """
+    from oktopk_tpu.collectives.registry import get_algorithm
+    if axis_name is not None and axis_name != cfg.inter_axis:
+        raise ValueError(
+            f"hierarchical runs over cfg axes ({cfg.inter_axis!r}, "
+            f"{cfg.intra_axis!r}); got axis_name={axis_name!r}")
+    ocfg = cfg.outer_cfg
+    bkt = ocfg.bucket_index
+
+    # level 0 — dense pmean down the intra axis (ICI): the pod-mean
+    # gradient, identical on every pod member afterwards.
+    with phase_scope("exchange", bkt, level=0):
+        g_pod = lax.pmean(grad, cfg.intra_axis)
+
+    # level 1 — the outer registry algorithm among pod leaders (DCN).
+    # Each pod member traces the identical exchange on identical inputs,
+    # which is the emulation of leader-exchange + intra broadcast.
+    outer_fn = get_algorithm(cfg.outer, warmup=cfg.outer_warmup)
+    with phase_scope(None, bkt, level=1):
+        out, s2 = outer_fn(g_pod, state, ocfg, cfg.inter_axis)
+
+    # Per-level accounting. The outer algorithm's bump() already added
+    # its own (inter) bytes/volume on top of ``state``; fold the intra
+    # ring allreduce on top and split the ledgers.
+    pod = cfg.pod_size
+    intra_vals = 2.0 * ocfg.n * (pod - 1) / max(1, pod)
+    intra_wb = dense_wire_bytes(intra_vals)
+    inter_wb = s2.last_wire_bytes
+    s2 = s2.replace(
+        volume_elems=s2.volume_elems + intra_vals,
+        last_volume=s2.last_volume + intra_vals,
+        wire_bytes=s2.wire_bytes + intra_wb,
+        last_wire_bytes=s2.last_wire_bytes + intra_wb,
+        wire_bytes_intra=state.wire_bytes_intra + intra_wb,
+        last_wire_bytes_intra=jnp.asarray(intra_wb, jnp.float32),
+        wire_bytes_inter=state.wire_bytes_inter + inter_wb,
+        last_wire_bytes_inter=inter_wb,
+    )
+    # Align the VMA of the (replicated-over-intra) results back to the
+    # full two-axis variance of the inputs so out_specs over both mesh
+    # axes type-check under check_vma.
+    return pvary_like((out, s2), grad)

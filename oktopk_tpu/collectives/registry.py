@@ -7,6 +7,7 @@ from __future__ import annotations
 from oktopk_tpu.collectives.dense import dense_allreduce, with_warmup
 from oktopk_tpu.collectives.gaussiank import gaussian_k
 from oktopk_tpu.collectives.gtopk import gtopk
+from oktopk_tpu.collectives.hierarchical import hierarchical
 from oktopk_tpu.collectives.oktopk import oktopk
 from oktopk_tpu.collectives.topk_allgather import topk_a, topk_a2, topk_a_opt
 from oktopk_tpu.collectives.topk_sa import gaussian_k_sa, topk_sa
@@ -25,7 +26,16 @@ ALGORITHMS = {
     # Script alias used by the reference job files (e.g. lstm_topkdsa.sh).
     "topkDSA": topk_sa,
     "oktopk": oktopk,
+    # Two-level composition (collectives/hierarchical.py): dense psum
+    # intra-pod, any of the above inter-pod. Takes a HierarchicalConfig
+    # and a (pod, data) mesh — build via api.build_allreduce_step.
+    "hierarchical": hierarchical,
 }
+
+
+def list_algorithms():
+    """Sorted registry listing (the names ``get_algorithm`` accepts)."""
+    return sorted(ALGORITHMS)
 
 
 def get_algorithm(name: str, warmup: bool = True):
@@ -35,7 +45,10 @@ def get_algorithm(name: str, warmup: bool = True):
         fn = ALGORITHMS[name]
     except KeyError:
         raise ValueError(
-            f"unknown compressor {name!r}; available: {sorted(ALGORITHMS)}")
-    if warmup and name != "dense":
+            f"unknown compressor {name!r}; available: {list_algorithms()}")
+    if warmup and name not in ("dense", "hierarchical"):
+        # hierarchical handles warmup on its OUTER level (the dense-outer
+        # warmup branch composed with the always-dense intra psum IS the
+        # full dense warmup); wrapping here would need a flat axis name.
         fn = with_warmup(fn)
     return fn

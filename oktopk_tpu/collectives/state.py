@@ -49,6 +49,15 @@ class SparseState:
     # (dense fallbacks, exact recomputes) account what actually ran.
     wire_bytes: jnp.ndarray           # f32 — cumulative over all steps
     last_wire_bytes: jnp.ndarray      # f32 — last step only
+    # Per-level wire accounting (collectives/hierarchical.py): bytes on
+    # the fast intra-pod edge vs the scarce inter-pod edge, so the DCN
+    # link is priced separately (obs/volume.py hierarchical budgets).
+    # Flat single-level algorithms leave all four at zero;
+    # wire_bytes == wire_bytes_intra + wire_bytes_inter when hierarchical.
+    wire_bytes_intra: jnp.ndarray      # f32 — cumulative, intra level
+    last_wire_bytes_intra: jnp.ndarray  # f32 — last step only
+    wire_bytes_inter: jnp.ndarray      # f32 — cumulative, inter level
+    last_wire_bytes_inter: jnp.ndarray  # f32 — last step only
     # realised selected counts (observability; reference logs these under
     # settings.PROFILING, VGG/allreducer.py:702-703)
     last_local_count: jnp.ndarray     # i32
@@ -77,6 +86,10 @@ def init_state(cfg: OkTopkConfig, dtype=jnp.float32) -> SparseState:
         last_volume=jnp.asarray(0.0, jnp.float32),
         wire_bytes=jnp.asarray(0.0, jnp.float32),
         last_wire_bytes=jnp.asarray(0.0, jnp.float32),
+        wire_bytes_intra=jnp.asarray(0.0, jnp.float32),
+        last_wire_bytes_intra=jnp.asarray(0.0, jnp.float32),
+        wire_bytes_inter=jnp.asarray(0.0, jnp.float32),
+        last_wire_bytes_inter=jnp.asarray(0.0, jnp.float32),
         last_local_count=jnp.asarray(0, jnp.int32),
         last_global_count=jnp.asarray(0, jnp.int32),
     )

@@ -15,9 +15,19 @@ implemented here:
                                    (free under pjit; no code needed)
 """
 
+from oktopk_tpu.comm.fabric import (  # noqa: F401
+    FABRIC_PRESETS,
+    FabricPreset,
+    TwoLevelFabric,
+    get_fabric,
+    two_level,
+)
 from oktopk_tpu.comm.mesh import (  # noqa: F401
     DATA_AXIS,
+    POD_AXIS,
     get_mesh,
+    hierarchical_mesh,
+    local_hierarchical_mesh,
     local_mesh,
 )
 from oktopk_tpu.comm.primitives import (  # noqa: F401

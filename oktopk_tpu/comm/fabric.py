@@ -1,0 +1,115 @@
+"""Named fabric presets and the two-level (intra, inter) fabric model.
+
+Single source of truth for the alpha-beta coefficients that were
+previously duplicated as literals inside ``scripts/project_multichip.py``.
+A preset is the projection convention ``(alpha seconds/message-round,
+bandwidth GB/s per worker)``:
+
+- ``ici``  — deliberately conservative effective ring bandwidth for a
+  v5e-class 2D torus slice;
+- ``dcn``  — multi-host pod-to-pod data-center network;
+- ``gbe``  — the 1.25 GB/s-class Ethernet the reference's cluster
+  results were gathered on.
+
+``TwoLevelFabric`` pairs an intra-pod link with an inter-pod link — the
+topology the hierarchical collective (collectives/hierarchical.py) runs
+on and the autotuner's per-level cost model prices
+(autotune/policy.predict_ms): dense psum rides the fast intra fabric,
+the sparse exchange crosses the scarce inter edge.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Tuple, Union
+
+#: Selection gamma (seconds/element) used when PLANNING for a target
+#: accelerator fabric from a preset. The cost-model default
+#: (utils/cost_model.topk_cost, 1e-9 s/elem ~ a CPU pass) overprices
+#: selection for an HBM-class chip by ~an order of magnitude; 2e-10
+#: models a few count/compact passes at effective HBM bandwidth and is
+#: applied uniformly to every sparse candidate so the ranking stays a
+#: fabric comparison, not a gamma artifact.
+PLAN_SELECT_GAMMA = 2e-10
+
+
+@dataclasses.dataclass(frozen=True)
+class FabricPreset:
+    """One named link: alpha-beta coefficients in projection convention."""
+
+    name: str
+    alpha_s: float            # seconds per message round
+    gbps: float               # effective GB/s per worker
+
+    def beta_elem(self, elem_bytes: int = 4) -> float:
+        """Seconds per transmitted element of ``elem_bytes`` bytes — the
+        beta the autotune cost model (seconds/element) consumes."""
+        return float(elem_bytes) / (self.gbps * 1e9)
+
+    def coefficients(self, elem_bytes: int = 4):
+        """This preset as ``autotune.calibrate.FabricCoefficients`` (the
+        planning substitute for a measured probe fit)."""
+        from oktopk_tpu.autotune.calibrate import FabricCoefficients
+        return FabricCoefficients(alpha=self.alpha_s,
+                                  beta=self.beta_elem(elem_bytes),
+                                  source=f"preset:{self.name}")
+
+
+FABRIC_PRESETS: Dict[str, FabricPreset] = {
+    "ici": FabricPreset("ici", 1e-6, 100.0),
+    "dcn": FabricPreset("dcn", 10e-6, 25.0),
+    "gbe": FabricPreset("gbe", 50e-6, 1.25),
+}
+
+
+def get_fabric(name: str) -> FabricPreset:
+    try:
+        return FABRIC_PRESETS[name]
+    except KeyError:
+        raise ValueError(f"unknown fabric preset {name!r}; "
+                         f"available: {sorted(FABRIC_PRESETS)}")
+
+
+def alpha_beta_table() -> Dict[str, Tuple[float, float]]:
+    """``{name: (alpha_s, gbps)}`` — the legacy literal shape
+    ``scripts/project_multichip.py`` exposes as its (mutable, per-run)
+    ``FABRICS`` module attribute. Returns a fresh dict each call so
+    callers may add scenario entries without mutating the presets."""
+    return {n: (p.alpha_s, p.gbps) for n, p in FABRIC_PRESETS.items()}
+
+
+@dataclasses.dataclass(frozen=True)
+class TwoLevelFabric:
+    """An (intra-pod, inter-pod) link pair for hierarchical planning."""
+
+    intra: FabricPreset
+    inter: FabricPreset
+
+    @property
+    def name(self) -> str:
+        return f"{self.intra.name}+{self.inter.name}"
+
+
+def two_level(inter: Union[str, FabricPreset] = "dcn",
+              intra: Union[str, FabricPreset] = "ici") -> TwoLevelFabric:
+    """Build a :class:`TwoLevelFabric`; string arguments name presets."""
+    if isinstance(inter, str):
+        inter = get_fabric(inter)
+    if isinstance(intra, str):
+        intra = get_fabric(intra)
+    return TwoLevelFabric(intra=intra, inter=inter)
+
+
+def resolve_two_level(
+        spec: Union[str, FabricPreset, TwoLevelFabric]) -> TwoLevelFabric:
+    """Normalise a fabric override to a :class:`TwoLevelFabric`.
+
+    A bare preset (or preset name) names the INTER edge — the scarce
+    resource a plan is made for — with ``ici`` assumed inside each pod
+    (so ``"ici"`` degenerates to a flat ici+ici world)."""
+    if isinstance(spec, TwoLevelFabric):
+        return spec
+    if isinstance(spec, FabricPreset):
+        return TwoLevelFabric(intra=FABRIC_PRESETS["ici"], inter=spec)
+    return TwoLevelFabric(intra=FABRIC_PRESETS["ici"],
+                          inter=get_fabric(spec))

@@ -19,6 +19,10 @@ DATA_AXIS = "data"
 MODEL_AXIS = "model"
 PIPE_AXIS = "pipe"
 SEQ_AXIS = "seq"
+# Two-level data parallelism (collectives/hierarchical.py): the OUTER
+# axis crossing the slow inter-pod edge; DATA_AXIS stays the intra-pod
+# axis so flat single-axis programs keep their name.
+POD_AXIS = "pod"
 
 
 def get_mesh(
@@ -43,3 +47,37 @@ def local_mesh(num: int = 1, axis_names: Sequence[str] = (DATA_AXIS,)) -> Mesh:
     """Mesh over the first ``num`` devices (single-chip testing)."""
     return get_mesh((num,) + (1,) * (len(axis_names) - 1), axis_names,
                     devices=jax.devices()[:num])
+
+
+def hierarchical_mesh(
+    num_pods: int,
+    pod_size: int,
+    axis_names: Sequence[str] = (POD_AXIS, DATA_AXIS),
+    devices=None,
+) -> Mesh:
+    """Two-level ``(pod, data)`` mesh: ``num_pods`` groups of ``pod_size``
+    devices. Devices are taken in order, so consecutive devices share a
+    pod — the layout under which intra-pod collectives ride the fast
+    links on real slices (and under which the emulated CPU mesh's pod
+    grouping is deterministic)."""
+    if num_pods < 1 or pod_size < 1:
+        raise ValueError(
+            f"need num_pods >= 1 and pod_size >= 1, got {num_pods}x{pod_size}")
+    if devices is None:
+        devices = jax.devices()
+    need = num_pods * pod_size
+    if len(devices) < need:
+        raise ValueError(f"hierarchical_mesh({num_pods}x{pod_size}) needs "
+                         f"{need} devices, have {len(devices)}")
+    return get_mesh((num_pods, pod_size), axis_names, devices=devices[:need])
+
+
+def local_hierarchical_mesh(num_pods: int = 2,
+                            pod_size: Optional[int] = None) -> Mesh:
+    """The emulated local device set presented as a two-level mesh —
+    8 virtual CPU devices become 2x4 (default) or 4x2. ``pod_size=None``
+    divides the available devices evenly over ``num_pods``."""
+    devices = jax.devices()
+    if pod_size is None:
+        pod_size = max(1, len(devices) // max(1, num_pods))
+    return hierarchical_mesh(num_pods, pod_size, devices=devices)

@@ -66,6 +66,10 @@ _COLLECTIVE_OPS = re.compile(
     r"|alltoall|allreduce|allgather|ppermute\b|\bpsum\b", re.I)
 
 _BUCKET_RE = re.compile(r"^b(\d+)$")
+# Optional hierarchy-level lane (collectives/hierarchical.py):
+# ``anat/b000/lvl1/exchange`` — level 0 = intra-pod, level 1 = inter-pod.
+# Legacy names carry no lvl component and parse exactly as before.
+_LEVEL_RE = re.compile(r"^lvl(\d+)$")
 
 # module-level switch for the bit-identity test and for opting the
 # annotations out entirely (OKTOPK_ANATOMY=0). Scopes are applied at
@@ -88,25 +92,30 @@ def annotations_enabled() -> bool:
 
 
 def scope_name(phase: Optional[str] = None,
-               bucket: Optional[int] = None) -> str:
-    """The contract name: ``anat``, ``anat/b003``, ``anat/select`` or
-    ``anat/b003/select``."""
+               bucket: Optional[int] = None,
+               level: Optional[int] = None) -> str:
+    """The contract name: ``anat``, ``anat/b003``, ``anat/select``,
+    ``anat/b003/select`` or — with a hierarchy level —
+    ``anat/b003/lvl1/exchange``."""
     parts = [SCOPE_PREFIX]
     if bucket is not None:
         parts.append(f"b{int(bucket):03d}")
+    if level is not None:
+        parts.append(f"lvl{int(level)}")
     if phase is not None:
         parts.append(str(phase))
     return "/".join(parts)
 
 
-def phase_scope(phase: Optional[str] = None, bucket: Optional[int] = None):
+def phase_scope(phase: Optional[str] = None, bucket: Optional[int] = None,
+                level: Optional[int] = None):
     """``jax.named_scope`` bearing the contract name (nullcontext when
     annotations are disabled). Pure metadata — usable inside jit,
     shard_map and ``lax.cond`` branches."""
     if not _ENABLED:
         return nullcontext()
     import jax
-    return jax.named_scope(scope_name(phase, bucket))
+    return jax.named_scope(scope_name(phase, bucket, level))
 
 
 @contextmanager
@@ -126,17 +135,22 @@ def trace_annotation(phase: Optional[str] = None,
         yield
 
 
-def parse_scope(name: Any) -> Optional[Tuple[Optional[str], Optional[int]]]:
-    """Extract ``(phase, bucket)`` from any name carrying the contract —
-    a bare annotation (``anat/b000/select``) or a compiled-HLO op path
+def parse_scope_level(
+        name: Any) -> Optional[Tuple[Optional[str], Optional[int],
+                                     Optional[int]]]:
+    """Extract ``(phase, bucket, level)`` from any name carrying the
+    contract — a bare annotation (``anat/b000/select``,
+    ``anat/b000/lvl1/exchange``) or a compiled-HLO op path
     (``jit(step)/.../anat/b000/anat/select/add``). Nested scopes merge:
-    bucket and phase may come from different ``anat`` components.
-    Returns None when the name carries no contract component."""
+    bucket, level and phase may come from different ``anat`` components.
+    Returns None when the name carries no contract component; ``level``
+    is None for legacy (single-level) names."""
     if not isinstance(name, str) or SCOPE_PREFIX not in name:
         return None
     parts = name.split("/")
     phase: Optional[str] = None
     bucket: Optional[int] = None
+    level: Optional[int] = None
     seen = False
     for i, part in enumerate(parts):
         if part != SCOPE_PREFIX:
@@ -148,9 +162,22 @@ def parse_scope(name: Any) -> Optional[Tuple[Optional[str], Optional[int]]]:
             if m:
                 bucket = int(m.group(1))
                 j += 1
+        if j < len(parts):
+            m = _LEVEL_RE.match(parts[j])
+            if m:
+                level = int(m.group(1))
+                j += 1
         if j < len(parts) and parts[j] in PHASES:
             phase = parts[j]
-    return (phase, bucket) if seen else None
+    return (phase, bucket, level) if seen else None
+
+
+def parse_scope(name: Any) -> Optional[Tuple[Optional[str], Optional[int]]]:
+    """Legacy ``(phase, bucket)`` view of :func:`parse_scope_level` —
+    level-lane components are transparent, so names with and without a
+    ``lvlN`` component round-trip identically."""
+    parsed = parse_scope_level(name)
+    return None if parsed is None else parsed[:2]
 
 
 def lane_of(phase: Optional[str], name: str = "") -> str:
@@ -253,33 +280,42 @@ def analyze_events(events: List[Dict[str, Any]]) -> Optional[Dict[str, Any]]:
     journals an ``anatomy_warning``). Times in the trace are
     microseconds (trace-event convention); everything returned is
     milliseconds."""
-    spans: List[Tuple[float, float, Optional[str], Optional[int], str]] = []
+    spans: List[Tuple[float, float, Optional[str], Optional[int], str,
+                      Optional[int]]] = []
     for e in events:
         if e.get("ph") != "X":
             continue
-        parsed = parse_scope(e.get("name"))
+        parsed = parse_scope_level(e.get("name"))
         if parsed is None:
             continue
         ts, dur = e.get("ts"), e.get("dur")
         if not isinstance(ts, (int, float)) or not isinstance(
                 dur, (int, float)) or dur < 0:
             continue
-        phase, bucket = parsed
+        phase, bucket, level = parsed
         start, end = float(ts) / 1e3, (float(ts) + float(dur)) / 1e3
         spans.append((start, end, phase, bucket,
-                      lane_of(phase, str(e.get("name")))))
+                      lane_of(phase, str(e.get("name"))), level))
     if not spans:
         return None
 
     t0 = min(s for s, *_ in spans)
     # per-(bucket, phase) totals; phase-less contract events (a bare
-    # "anat/b000" container) attribute to phase "other"
+    # "anat/b000" container) attribute to phase "other". Level-tagged
+    # spans (hierarchical collectives) get their own lane key
+    # ("lvl1/exchange") so the two levels of one phase never merge;
+    # legacy keys are unchanged.
     per: Dict[Tuple[int, str], Dict[str, Any]] = {}
     compute_iv: List[Tuple[float, float]] = []
     comm_iv: List[Tuple[float, float]] = []
-    for start, end, phase, bucket, lane in spans:
-        key = (-1 if bucket is None else int(bucket), phase or "other")
+    for start, end, phase, bucket, lane, level in spans:
+        pkey = phase or "other"
+        if level is not None:
+            pkey = f"lvl{int(level)}/{pkey}"
+        key = (-1 if bucket is None else int(bucket), pkey)
         d = per.setdefault(key, {"ms": 0.0, "count": 0, "lane": lane})
+        if level is not None:
+            d["level"] = int(level)
         d["ms"] += end - start
         d["count"] += 1
         if lane == "collective":
@@ -304,7 +340,7 @@ def analyze_events(events: List[Dict[str, Any]]) -> Optional[Dict[str, Any]]:
     for lo, hi in zip(bounds[:-1], bounds[1:]):
         if hi <= lo:
             continue
-        active = [ph or "other" for s, e, ph, _b, _l in spans
+        active = [ph or "other" for s, e, ph, _b, _l, _lv in spans
                   if s <= lo and e >= hi]
         if not active:
             critical["idle"] = critical.get("idle", 0.0) + (hi - lo)
@@ -318,8 +354,11 @@ def analyze_events(events: List[Dict[str, Any]]) -> Optional[Dict[str, Any]]:
 
     buckets: Dict[int, Dict[str, Dict[str, Any]]] = {}
     for (bucket, phase), d in sorted(per.items()):
-        buckets.setdefault(bucket, {})[phase] = {
-            "ms": round(d["ms"], 4), "count": d["count"], "lane": d["lane"]}
+        entry = {"ms": round(d["ms"], 4), "count": d["count"],
+                 "lane": d["lane"]}
+        if "level" in d:
+            entry["level"] = d["level"]
+        buckets.setdefault(bucket, {})[phase] = entry
     return {
         "buckets": buckets,
         "compute_ms": round(compute_ms, 4),
@@ -343,6 +382,10 @@ def phase_totals(analysis: Dict[str, Any]) -> Dict[str, float]:
     totals: Dict[str, float] = {}
     for phases in analysis.get("buckets", {}).values():
         for ph, d in phases.items():
+            # level-tagged keys ("lvl1/exchange") fold into their phase
+            # family so regression limits keyed by phase keep applying
+            if _LEVEL_RE.match(ph.split("/", 1)[0]):
+                ph = ph.split("/", 1)[1] if "/" in ph else "other"
             totals[ph] = round(totals.get(ph, 0.0) + float(d["ms"]), 4)
     return totals
 
@@ -364,10 +407,13 @@ def emit_anatomy(bus, analysis: Optional[Dict[str, Any]], step: int = 0,
             path=warn_path, source=source)
         return
     for bucket, phases in sorted(analysis["buckets"].items()):
+        levels = sorted({d["level"] for d in phases.values()
+                         if "level" in d})
+        extra = {"levels": levels} if levels else {}
         put("step_anatomy", step=int(step), bucket=int(bucket),
             phases=phases,
             total_ms=round(sum(d["ms"] for d in phases.values()), 4),
-            source=source)
+            source=source, **extra)
     put("overlap_report", step=int(step),
         compute_ms=analysis["compute_ms"], comm_ms=analysis["comm_ms"],
         overlap_ms=analysis["overlap_ms"],

@@ -68,17 +68,23 @@ EVENT_SCHEMAS: Dict[str, Dict[str, Dict[str, tuple]]] = {
     # standalone DecisionJournal file keeps (pre-obs compatibility);
     # "autotune_decision" is the same payload on the unified bus
     # (journal.py _BUS_EVENT_REMAP).
+    # Plan-mode decisions (fabric-preset pricing, no trials) add
+    # "fabric" (preset name, e.g. "ici+dcn") and "num_pods"; their
+    # chosen/candidates dicts may carry "outer" and a per-level
+    # "levels" list for hierarchical candidates.
     "decision": {
         "required": {"step": _NUM, "bucket": _NUM, "chosen": _DICT,
                      "reason": _STR},
         "optional": {"n": _NUM, "num_workers": _NUM,
-                     "candidates": _LIST, "incumbent": _OPT_DICT},
+                     "candidates": _LIST, "incumbent": _OPT_DICT,
+                     "fabric": _STR, "num_pods": _NUM},
     },
     "autotune_decision": {
         "required": {"step": _NUM, "bucket": _NUM, "chosen": _DICT,
                      "reason": _STR},
         "optional": {"n": _NUM, "num_workers": _NUM,
-                     "candidates": _LIST, "incumbent": _OPT_DICT},
+                     "candidates": _LIST, "incumbent": _OPT_DICT,
+                     "fabric": _STR, "num_pods": _NUM},
     },
     # resilience events (resilience/journal.py HealthJournal)
     "guard_trip": {
@@ -166,13 +172,15 @@ EVENT_SCHEMAS: Dict[str, Dict[str, Dict[str, tuple]]] = {
         "optional": {"logdir": _OPT_STR},
     },
     # end-of-run per-bucket wire-volume conformance (trainer.py +
-    # obs/volume.py)
+    # obs/volume.py). Two-level runs emit one report per level plus a
+    # combined one, tagged "level": "intra" | "inter" | "total"
+    # (obs/volume.hierarchical_volume_report); flat reports omit it.
     "volume_report": {
         "required": {"step": _NUM, "bucket": _NUM, "algo": _STR},
         "optional": {"n": _NUM, "density": _NUM, "steps": _NUM,
                      "wire_bytes": _NUM, "mean_wire_bytes": _NUM,
                      "budget_bytes": _NUM, "capacity_bytes": _NUM,
-                     "conformance_ratio": _NUM},
+                     "conformance_ratio": _NUM, "level": _STR},
     },
     # host phase-timer snapshot (utils/profiling.py PhaseTimers.summary)
     "phase": {
@@ -223,11 +231,13 @@ EVENT_SCHEMAS: Dict[str, Dict[str, Dict[str, tuple]]] = {
     # maps phase name -> {"ms", "count", "lane"}; model-level unbucketed
     # phases (fwd_bwd, optimizer) land on bucket -1. "source" says how
     # the trace was captured ("host_probe" for the CPU per-phase
-    # dispatch driver, "trace" for an in-jit device capture).
+    # dispatch driver, "trace" for an in-jit device capture). Two-level
+    # collectives tag phases with a level lane (anat/bNNN/lvlN/phase);
+    # "levels" lists the distinct level indices seen in the capture.
     "step_anatomy": {
         "required": {"step": _NUM, "bucket": _NUM, "phases": _DICT},
         "optional": {"total_ms": _NUM, "source": _STR,
-                     "schema_version": _NUM},
+                     "schema_version": _NUM, "levels": _LIST},
     },
     # the overlap scorecard for one captured step (obs/anatomy.py):
     # compute/comm lane unions, their intersection, overlap_ratio =

@@ -32,6 +32,13 @@ Budget semantics differ by family, on purpose:
 - ``gaussiankSA``: same split phase + always-sparse gather.
 - ``dense``: 2n f32 values (ring-allreduce send+receive; the psum is
   never wire-rounded).
+- ``hierarchical``: PER LEVEL (``hierarchical_budget_bytes``). The
+  intra level is a dense ring over the pod — 2n(P_pod−1)/P_pod f32
+  values, exact. The inter level is the OUTER algorithm's existing
+  budget evaluated at P=num_pods. The flat entry points accept a
+  ``HierarchicalConfig`` with ``name="hierarchical"`` and return the
+  level sum; ``hierarchical_volume_report`` emits one level-tagged
+  ``volume_report`` payload per level plus a combined total.
 
 ``capacity_bytes`` is the static buffer ceiling for every algorithm —
 the absolute worst case any step (including oktopk exact recomputes)
@@ -52,10 +59,44 @@ def _canon(name: str) -> str:
     return _ALIAS.get(name, name)
 
 
+def _intra_budget_bytes(hcfg) -> float:
+    """Dense ring allreduce over the pod: 2n(P_pod−1)/P_pod f32 values —
+    the exact pattern collectives/hierarchical.py accounts per step."""
+    pod = hcfg.pod_size
+    return 2.0 * hcfg.n * (pod - 1) / max(1, pod) * 4.0
+
+
+def hierarchical_budget_bytes(hcfg) -> dict:
+    """Per-level steady-state budgets for a ``HierarchicalConfig``:
+    ``{"intra": dense-ring bytes over the pod, "inter": the outer
+    algorithm's flat budget at P=num_pods}``."""
+    return {"intra": _intra_budget_bytes(hcfg),
+            "inter": budget_bytes(hcfg.outer, hcfg.outer_cfg)}
+
+
+def _as_hierarchical(name: str, cfg):
+    """Return cfg as a HierarchicalConfig when ``name`` names the
+    two-level composition, else None (lazy import keeps obs free of a
+    static collectives dependency)."""
+    if name != "hierarchical":
+        return None
+    from oktopk_tpu.collectives.hierarchical import HierarchicalConfig
+    if not isinstance(cfg, HierarchicalConfig):
+        raise TypeError("'hierarchical' volume accounting needs a "
+                        f"HierarchicalConfig, got {type(cfg).__name__}")
+    return cfg
+
+
 def budget_bytes(name: str, cfg: OkTopkConfig) -> float:
     """Per-worker steady-state wire-byte budget for one step of
     algorithm ``name`` under ``cfg``. Measured ``last_wire_bytes`` must
-    satisfy ``measured <= budget`` (conformance ratio <= 1.0)."""
+    satisfy ``measured <= budget`` (conformance ratio <= 1.0).
+
+    ``name="hierarchical"`` (with a ``HierarchicalConfig``) returns the
+    level sum — see :func:`hierarchical_budget_bytes` for the split."""
+    hcfg = _as_hierarchical(name, cfg)
+    if hcfg is not None:
+        return float(sum(hierarchical_budget_bytes(hcfg).values()))
     name = _canon(name)
     P, n, k = cfg.num_workers, cfg.n, cfg.k
     pair = float(cfg.wire_pair_bytes)
@@ -82,7 +123,12 @@ def budget_bytes(name: str, cfg: OkTopkConfig) -> float:
 
 def capacity_bytes(name: str, cfg: OkTopkConfig) -> float:
     """Static worst-case ceiling: the most any single step (including
-    oktopk's exact-recompute steps) can put on the wire per worker."""
+    oktopk's exact-recompute steps) can put on the wire per worker.
+    Hierarchical: the (exact) intra ring plus the outer capacity."""
+    hcfg = _as_hierarchical(name, cfg)
+    if hcfg is not None:
+        return float(_intra_budget_bytes(hcfg)
+                     + capacity_bytes(hcfg.outer, hcfg.outer_cfg))
     name = _canon(name)
     P, n, k = cfg.num_workers, cfg.n, cfg.k
     pair = float(cfg.wire_pair_bytes)
@@ -132,3 +178,50 @@ def volume_report(name: str, cfg: OkTopkConfig, mean_wire_bytes: float,
         "conformance_ratio": conformance_ratio(name, cfg,
                                                mean_wire_bytes),
     }
+
+
+def hierarchical_volume_report(hcfg, mean_intra_bytes: float,
+                               mean_inter_bytes: float, *,
+                               bucket: int = 0, step: int = 0,
+                               steps: int = 0) -> list:
+    """Per-level ``volume_report`` payloads for a two-level run.
+
+    Takes the measured per-step means of ``SparseState.
+    last_wire_bytes_intra`` / ``last_wire_bytes_inter`` and returns
+    THREE level-tagged payloads — ``level="intra"`` (dense ring vs its
+    exact budget), ``level="inter"`` (the outer algorithm vs its flat
+    budget at P=num_pods), and ``level="total"`` (the sums, whose
+    ``conformance_ratio`` is the combined invariant the acceptance
+    tests hold <= 1.0). Each payload validates against the flat
+    ``volume_report`` schema; ``level`` is the only added field."""
+    budgets = hierarchical_budget_bytes(hcfg)
+    ocfg = hcfg.outer_cfg
+    base = {"step": int(step), "bucket": int(bucket), "n": int(hcfg.n),
+            "steps": int(steps)}
+    intra_b = budgets["intra"]
+    levels = [
+        {**base, "level": "intra", "algo": hcfg.inner, "density": 1.0,
+         "mean_wire_bytes": float(mean_intra_bytes),
+         "budget_bytes": float(intra_b),
+         "capacity_bytes": float(intra_b),
+         "conformance_ratio": (float(mean_intra_bytes) / intra_b
+                               if intra_b > 0 else float("inf"))},
+        {**base, "level": "inter", "algo": hcfg.outer,
+         "density": float(ocfg.density),
+         "mean_wire_bytes": float(mean_inter_bytes),
+         "budget_bytes": float(budgets["inter"]),
+         "capacity_bytes": float(capacity_bytes(hcfg.outer, ocfg)),
+         "conformance_ratio": conformance_ratio(hcfg.outer, ocfg,
+                                                mean_inter_bytes)},
+    ]
+    total_mean = float(mean_intra_bytes) + float(mean_inter_bytes)
+    total_budget = float(sum(budgets.values()))
+    levels.append(
+        {**base, "level": "total", "algo": "hierarchical",
+         "density": float(hcfg.density),
+         "mean_wire_bytes": total_mean,
+         "budget_bytes": total_budget,
+         "capacity_bytes": float(capacity_bytes("hierarchical", hcfg)),
+         "conformance_ratio": (total_mean / total_budget
+                               if total_budget > 0 else float("inf"))})
+    return levels

@@ -101,18 +101,25 @@ def _volume_lines(entries: List[Dict[str, Any]]) -> List[str]:
     reports = [e for e in entries if e.get("event") == "volume_report"]
     if not reports:
         return []
-    out = ["volume conformance (measured mean vs analytic budget):",
-           f"  {'bucket':>6} {'algo':<14} {'mean/step':>12} "
-           f"{'budget':>12} {'ratio':>7}"]
+    # two-level runs tag each report with its level; legacy flat
+    # journals never carry the field and keep the narrower table
+    levelled = any("level" in r for r in reports)
+    hdr = f"  {'bucket':>6} {'algo':<14} "
+    if levelled:
+        hdr += f"{'level':<6} "
+    hdr += f"{'mean/step':>12} {'budget':>12} {'ratio':>7}"
+    out = ["volume conformance (measured mean vs analytic budget):", hdr]
     for r in reports:
         ratio = r.get("conformance_ratio")
         ratio_s = (f"{ratio:>7.3f}"
                    if isinstance(ratio, (int, float)) else f"{'?':>7}")
-        out.append(
-            f"  {r.get('bucket', '?'):>6} {r.get('algo', '?'):<14} "
-            f"{_fmt_bytes(float(r.get('mean_wire_bytes', 0))):>12} "
-            f"{_fmt_bytes(float(r.get('budget_bytes', 0))):>12} "
-            + ratio_s)
+        line = f"  {r.get('bucket', '?'):>6} {r.get('algo', '?'):<14} "
+        if levelled:
+            line += f"{r.get('level', '-'):<6} "
+        line += (f"{_fmt_bytes(float(r.get('mean_wire_bytes', 0))):>12} "
+                 f"{_fmt_bytes(float(r.get('budget_bytes', 0))):>12} "
+                 + ratio_s)
+        out.append(line)
     return out
 
 

@@ -52,6 +52,9 @@ import sys
 
 HERE = os.path.dirname(os.path.abspath(__file__))
 REPO = os.path.dirname(HERE)
+sys.path.insert(0, REPO)
+
+from oktopk_tpu.comm.fabric import alpha_beta_table  # noqa: E402
 
 # ---- constants (every one surfaced in the output record) -------------------
 
@@ -65,14 +68,10 @@ WIRE_PAIR_BYTES = 6       # int32 index + bf16 value (config.wire_pair_bytes)
 DENSE_ELEM_BYTES = 4      # f32 ring allreduce
 
 # Fabric presets: (alpha seconds/message-round, bandwidth GB/s per worker).
-# ICI: deliberately conservative effective ring bandwidth for a v5e-class
-# 2D torus; DCN: multi-host pod-to-pod; GBE: the 1.25 GB/s-class Ethernet
-# the reference's cluster results were gathered on.
-FABRICS = {
-    "ici": (1e-6, 100.0),
-    "dcn": (10e-6, 25.0),
-    "gbe": (50e-6, 1.25),
-}
+# Single source of truth is oktopk_tpu/comm/fabric.py (ICI / DCN / GBE
+# rationale documented there); this module keeps a fresh mutable copy so
+# scenario runs (and tests) may add entries without touching the presets.
+FABRICS = alpha_beta_table()
 
 
 def load_bench_records():

@@ -1,0 +1,510 @@
+"""Two-level hierarchical allreduce: fabric presets, (pod, data) meshes,
+the flat-composition oracle, per-level wire conformance, and plan-mode
+autotuning (ISSUE: dense intra-pod, sparse inter-pod, priced per level).
+
+The load-bearing oracle: after the lossless intra psum every pod member
+holds the pod-mean gradient, so hierarchical(inner=dense, outer=X) over
+a (2 pods x 4) mesh must BIT-EXACTLY match flat X over 2 workers fed the
+pre-psum'd (pod-mean) gradients — same outputs, same residuals, same
+inter-level wire bytes. That is SparCML's decomposition (arXiv
+1802.08021) restated as a testable identity.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from oktopk_tpu.collectives.api import (batched_init_state,
+                                        build_allreduce_step,
+                                        build_quality_allreduce_step)
+from oktopk_tpu.collectives.hierarchical import (HierarchicalConfig,
+                                                 make_hierarchical_config)
+from oktopk_tpu.collectives.registry import get_algorithm, list_algorithms
+from oktopk_tpu.comm.fabric import (FABRIC_PRESETS, PLAN_SELECT_GAMMA,
+                                    FabricPreset, TwoLevelFabric, get_fabric,
+                                    resolve_two_level, two_level)
+from oktopk_tpu.comm.mesh import (DATA_AXIS, POD_AXIS, hierarchical_mesh,
+                                  local_hierarchical_mesh)
+from oktopk_tpu.config import OkTopkConfig
+from oktopk_tpu.obs.events import validate_event, validate_journal
+from oktopk_tpu.obs.volume import (budget_bytes, hierarchical_budget_bytes,
+                                   hierarchical_volume_report)
+
+pytestmark = pytest.mark.hierarchical
+
+N = 512
+PODS, POD_SIZE = 2, 4
+P = PODS * POD_SIZE
+
+
+@pytest.fixture(scope="module")
+def hmesh(devices):
+    return hierarchical_mesh(PODS, POD_SIZE, devices=devices[:P])
+
+
+@pytest.fixture(scope="module")
+def mesh2(devices):
+    from oktopk_tpu.comm import get_mesh
+    return get_mesh((2,), (DATA_AXIS,), devices=devices[:2])
+
+
+def make_flat_cfg(**kw):
+    kw.setdefault("n", N)
+    kw.setdefault("num_workers", P)
+    kw.setdefault("warmup_steps", 0)
+    return OkTopkConfig(**kw)
+
+
+def hier_grads(rng, scale=1.0):
+    """[P, n] grads for the (pod, data) mesh plus the pod-mean [PODS, n]
+    view a flat run over PODS workers sees after the intra psum."""
+    g = rng.randn(P, N).astype(np.float32) * scale
+    pod_mean = g.reshape(PODS, POD_SIZE, N).mean(1)
+    return g, pod_mean
+
+
+# ---------------------------------------------------------------------------
+# fabric presets (the literals that used to live in project_multichip.py)
+# ---------------------------------------------------------------------------
+
+class TestFabricPresets:
+    def test_named_presets_keep_projection_literals(self):
+        # scripts/project_multichip.py's original (alpha_s, gbps) table —
+        # moving the literals into comm/fabric.py must not change them
+        assert FABRIC_PRESETS["ici"].alpha_s == 1e-6
+        assert FABRIC_PRESETS["ici"].gbps == 100.0
+        assert FABRIC_PRESETS["dcn"].alpha_s == 10e-6
+        assert FABRIC_PRESETS["dcn"].gbps == 25.0
+        assert FABRIC_PRESETS["gbe"].alpha_s == 50e-6
+        assert FABRIC_PRESETS["gbe"].gbps == 1.25
+
+    def test_projection_script_imports_the_table(self):
+        import importlib.util
+        import os
+        spec = importlib.util.spec_from_file_location(
+            "pm_test", os.path.join(os.path.dirname(__file__), os.pardir,
+                                    "scripts", "project_multichip.py"))
+        pm = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(pm)
+        for name, preset in FABRIC_PRESETS.items():
+            assert pm.FABRICS[name] == (preset.alpha_s, preset.gbps)
+
+    def test_beta_elem_is_bytes_over_linerate(self):
+        ici = get_fabric("ici")
+        assert ici.beta_elem() == pytest.approx(4.0 / (100.0 * 1e9))
+        assert ici.beta_elem(2) == pytest.approx(2.0 / (100.0 * 1e9))
+
+    def test_coefficients_carry_preset_source(self):
+        c = get_fabric("dcn").coefficients()
+        assert c.alpha == pytest.approx(10e-6)
+        assert c.source == "preset:dcn"
+
+    def test_unknown_fabric_lists_presets(self):
+        with pytest.raises(ValueError, match="dcn"):
+            get_fabric("infiniband")
+
+    def test_two_level_and_resolve(self):
+        tw = two_level("dcn")
+        assert isinstance(tw, TwoLevelFabric)
+        assert tw.intra.name == "ici" and tw.inter.name == "dcn"
+        assert tw.name == "ici+dcn"
+        assert resolve_two_level("gbe").inter.name == "gbe"
+        assert resolve_two_level(FABRIC_PRESETS["dcn"]).inter.name == "dcn"
+        assert resolve_two_level(tw) is tw
+
+
+# ---------------------------------------------------------------------------
+# hierarchical meshes and configs
+# ---------------------------------------------------------------------------
+
+class TestHierarchicalMesh:
+    @pytest.mark.parametrize("pods,pod_size", [(2, 4), (4, 2)])
+    def test_shapes_and_axis_names(self, devices, pods, pod_size):
+        m = hierarchical_mesh(pods, pod_size, devices=devices[:8])
+        assert m.devices.shape == (pods, pod_size)
+        assert m.axis_names == (POD_AXIS, DATA_AXIS)
+
+    def test_insufficient_devices(self, devices):
+        with pytest.raises(ValueError, match="devices"):
+            hierarchical_mesh(4, 4, devices=devices[:8])
+
+    def test_local_derives_pod_size(self):
+        m = local_hierarchical_mesh(num_pods=2)
+        assert m.devices.shape[0] == 2
+        assert m.devices.size == m.devices.shape[0] * m.devices.shape[1]
+
+
+class TestHierarchicalConfig:
+    def test_make_splits_density_onto_outer(self):
+        flat = make_flat_cfg(density=0.05)
+        h = make_hierarchical_config(flat, num_pods=PODS, outer="oktopk")
+        assert h.pod_size == POD_SIZE
+        assert h.num_workers == P
+        assert h.outer_cfg.num_workers == PODS
+        assert h.outer_cfg.density == pytest.approx(0.05)
+        assert h.density == pytest.approx(0.05)
+        half = make_hierarchical_config(flat, num_pods=PODS, outer="oktopk",
+                                        density_split=0.5)
+        assert half.outer_cfg.density == pytest.approx(0.025)
+
+    def test_dense_outer_keeps_full_density(self):
+        h = make_hierarchical_config(make_flat_cfg(density=0.05),
+                                     num_pods=PODS, outer="dense")
+        assert h.outer_cfg.density == 1.0
+
+    def test_level_plan(self):
+        h = make_hierarchical_config(make_flat_cfg(density=0.02),
+                                     num_pods=PODS, outer="topkA")
+        assert h.level_plan() == [
+            {"level": "intra", "algo": "dense", "density": 1.0},
+            {"level": "inter", "algo": "topkA", "density": 0.02}]
+
+    def test_validation(self):
+        flat = make_flat_cfg(density=0.05)
+        with pytest.raises(ValueError, match="divisible"):
+            make_hierarchical_config(flat, num_pods=3)
+        with pytest.raises(ValueError, match="dense"):
+            make_hierarchical_config(flat, num_pods=2, inner="oktopk")
+        with pytest.raises(ValueError, match="differ"):
+            make_hierarchical_config(flat, num_pods=2,
+                                     inter_axis="x", intra_axis="x")
+        with pytest.raises(ValueError, match="num_workers"):
+            HierarchicalConfig(outer_cfg=flat, num_pods=2, pod_size=4)
+
+    def test_registry_lists_and_errors_mention_hierarchical(self):
+        assert "hierarchical" in list_algorithms()
+        with pytest.raises(ValueError, match="hierarchical"):
+            get_algorithm("nope")
+
+    def test_build_step_rejects_flat_config(self, hmesh):
+        with pytest.raises(TypeError, match="HierarchicalConfig"):
+            build_allreduce_step("hierarchical", make_flat_cfg(density=0.05),
+                                 hmesh)
+
+    def test_build_step_rejects_mismatched_mesh(self, mesh2):
+        h = make_hierarchical_config(make_flat_cfg(density=0.05),
+                                     num_pods=PODS)
+        with pytest.raises(ValueError, match="mesh axis"):
+            build_allreduce_step("hierarchical", h, mesh2)
+
+    def test_batched_state_covers_total_workers(self):
+        h = make_hierarchical_config(make_flat_cfg(density=0.05),
+                                     num_pods=PODS)
+        st = batched_init_state(h)
+        assert st.residual.shape == (P, N)
+        assert float(st.wire_bytes_intra[0]) == 0.0
+
+
+# ---------------------------------------------------------------------------
+# the flat-composition oracle
+# ---------------------------------------------------------------------------
+
+def _run_steps(step, grads_seq, state):
+    outs = []
+    for g in grads_seq:
+        out, state = step(jnp.asarray(g), state)
+        outs.append(np.asarray(out))
+    return outs, state
+
+
+@pytest.mark.parametrize("outer", ["dense", "oktopk", "topkA"])
+def test_oracle_matches_flat_outer(hmesh, mesh2, outer):
+    """hierarchical(inner=dense, outer=X) over 2x4 == flat X over 2
+    workers on the pod-mean gradients: outputs, residuals, and the
+    inter-level wire bytes, bit-exactly, across steps."""
+    rng = np.random.RandomState(3)
+    flat = make_flat_cfg(density=0.05)
+    h = make_hierarchical_config(flat, num_pods=PODS, outer=outer)
+    hstep = build_allreduce_step("hierarchical", h, hmesh, warmup=False)
+    fstep = build_allreduce_step(outer, h.outer_cfg, mesh2, warmup=False)
+
+    gs = [hier_grads(rng) for _ in range(2)]
+    houts, hstate = _run_steps(hstep, [g for g, _ in gs],
+                               batched_init_state(h))
+    fouts, fstate = _run_steps(fstep, [pm for _, pm in gs],
+                               batched_init_state(h.outer_cfg))
+    for ho, fo in zip(houts, fouts):
+        np.testing.assert_array_equal(ho[0], fo[0])
+    np.testing.assert_array_equal(np.asarray(hstate.residual[0]),
+                                  np.asarray(fstate.residual[0]))
+    # per-level wire split: inter == the flat run's wire, intra == the
+    # dense pod ring (2n(P_pod-1)/P_pod f32 values per step)
+    assert float(hstate.wire_bytes_inter[0]) == float(fstate.wire_bytes[0])
+    want_intra = 2.0 * N * (POD_SIZE - 1) / POD_SIZE * 4.0 * len(gs)
+    assert float(hstate.wire_bytes_intra[0]) == pytest.approx(want_intra)
+    assert float(hstate.wire_bytes[0]) == pytest.approx(
+        float(hstate.wire_bytes_intra[0]) + float(hstate.wire_bytes_inter[0]))
+
+
+def test_outer_warmup_composes_full_dense(hmesh):
+    """warmup=True on the build composes dense warmup on the OUTER level;
+    with the always-dense intra psum the first steps equal the full-world
+    dense mean."""
+    rng = np.random.RandomState(5)
+    flat = make_flat_cfg(density=0.05, warmup_steps=1)
+    h = make_hierarchical_config(flat, num_pods=PODS, outer="oktopk")
+    hstep = build_allreduce_step("hierarchical", h, hmesh, warmup=True)
+    g, _ = hier_grads(rng)
+    out, state = hstep(jnp.asarray(g), batched_init_state(h))
+    np.testing.assert_allclose(np.asarray(out[0]), g.mean(0), atol=1e-5)
+    assert int(state.step[0]) == 1
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("outer", ["oktopk", "topkA"])
+def test_oracle_multi_step_sweep(hmesh, mesh2, outer):
+    """Longer stateful sweep (thresholds re-estimate, residuals build):
+    the composition identity must hold at every step, not just the
+    first two."""
+    rng = np.random.RandomState(11)
+    flat = make_flat_cfg(density=0.02)
+    h = make_hierarchical_config(flat, num_pods=PODS, outer=outer)
+    hstep = build_allreduce_step("hierarchical", h, hmesh, warmup=False)
+    fstep = build_allreduce_step(outer, h.outer_cfg, mesh2, warmup=False)
+    hstate = batched_init_state(h)
+    fstate = batched_init_state(h.outer_cfg)
+    base = rng.randn(P, N).astype(np.float32)
+    for i in range(8):
+        g = base + 0.3 * rng.randn(P, N).astype(np.float32)
+        pm = g.reshape(PODS, POD_SIZE, N).mean(1)
+        hout, hstate = hstep(jnp.asarray(g), hstate)
+        fout, fstate = fstep(jnp.asarray(pm), fstate)
+        np.testing.assert_array_equal(np.asarray(hout[0]),
+                                      np.asarray(fout[0]))
+    np.testing.assert_array_equal(np.asarray(hstate.residual[0]),
+                                  np.asarray(fstate.residual[0]))
+    assert float(hstate.wire_bytes_inter[0]) == float(fstate.wire_bytes[0])
+
+
+def test_quality_tap_dense_outer_zero_comp_err(hmesh):
+    """The signal-fidelity oracle is unchanged by the hierarchy: with a
+    dense outer the composition is lossless, so the tap's comp_err is ~0."""
+    from oktopk_tpu.obs.metrics_buffer import (COLUMNS, init_buffer,
+                                               rows_since)
+    from oktopk_tpu.obs.quality import QualityConfig
+
+    rng = np.random.RandomState(9)
+    flat = make_flat_cfg(density=0.05)
+    h = make_hierarchical_config(flat, num_pods=PODS, outer="dense")
+    q = QualityConfig(every=2, sig_bins=256)
+    step = build_quality_allreduce_step("hierarchical", h, hmesh, q,
+                                        warmup=False)
+    state = batched_init_state(h)
+    qb = jax.tree.map(lambda x: jnp.broadcast_to(x, (P,) + x.shape),
+                      init_buffer(q.every, q.sig_bins))
+    g, _ = hier_grads(rng)
+    out, state, qb = step(jnp.asarray(g), state, qb)
+    hb = jax.device_get(qb)
+    row = rows_since(np.asarray(hb.ring),
+                     int(np.asarray(hb.cursor).reshape(-1)[0]), 0)[-1]
+    assert row[COLUMNS.index("comp_err")] == pytest.approx(0.0, abs=1e-10)
+    np.testing.assert_allclose(np.asarray(out[0]), g.mean(0), atol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# per-level wire conformance + level-tagged volume_report events
+# ---------------------------------------------------------------------------
+
+def test_per_level_conformance_and_journal(hmesh):
+    """Measured per-level means vs the per-level analytic budgets: every
+    level's conformance_ratio <= 1.0, and the level-tagged volume_report
+    events validate on the unified journal."""
+    from oktopk_tpu.obs.journal import EventBus, RunJournal
+
+    rng = np.random.RandomState(13)
+    flat = make_flat_cfg(density=0.05, local_recompute_every=1,
+                         global_recompute_every=4)
+    h = make_hierarchical_config(flat, num_pods=PODS, outer="oktopk")
+    hstep = build_allreduce_step("hierarchical", h, hmesh, warmup=False)
+    state = batched_init_state(h)
+    steps = 9
+    intra, inter = [], []
+    for i in range(steps):
+        g, _ = hier_grads(rng)
+        _, state = hstep(jnp.asarray(g), state)
+        # steady-state mean, like tests/test_obs.py: oktopk's every-4th
+        # exact recompute draws from the larger cap_exact pool and is
+        # excluded from the 3k-pair steady-state budget check
+        if i % h.outer_cfg.global_recompute_every != 0:
+            intra.append(float(state.last_wire_bytes_intra[0]))
+            inter.append(float(state.last_wire_bytes_inter[0]))
+
+    mean_intra = sum(intra) / len(intra)
+    mean_inter = sum(inter) / len(inter)
+    budgets = hierarchical_budget_bytes(h)
+    assert budgets["intra"] == pytest.approx(
+        2.0 * N * (POD_SIZE - 1) / POD_SIZE * 4.0)
+    assert budgets["inter"] == budget_bytes("oktopk", h.outer_cfg)
+    assert budget_bytes("hierarchical", h) == pytest.approx(
+        sum(budgets.values()))
+
+    bus = EventBus()
+    journal = RunJournal(bus=bus)
+    reports = hierarchical_volume_report(h, mean_intra, mean_inter,
+                                         bucket=0, step=steps, steps=steps)
+    assert [r["level"] for r in reports] == ["intra", "inter", "total"]
+    for r in reports:
+        assert r["conformance_ratio"] <= 1.0, r
+        bus.emit("volume_report", **r)
+        assert validate_event({"event": "volume_report", **r}) == []
+    assert validate_journal(journal.entries) == []
+    total = reports[-1]
+    assert total["mean_wire_bytes"] == pytest.approx(
+        mean_intra + mean_inter)
+
+
+def test_obs_report_renders_level_column():
+    import importlib.util
+    import os
+    spec = importlib.util.spec_from_file_location(
+        "obs_report_test", os.path.join(os.path.dirname(__file__),
+                                        os.pardir, "scripts",
+                                        "obs_report.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    h = make_hierarchical_config(make_flat_cfg(density=0.05),
+                                 num_pods=PODS, outer="oktopk")
+    reps = hierarchical_volume_report(h, 1536.0, 100.0, bucket=0, step=4,
+                                      steps=4)
+    lines = mod._volume_lines(
+        [{"event": "volume_report", **r} for r in reps])
+    assert any("level" in ln for ln in lines)
+    assert any(" intra " in ln for ln in lines)
+    # legacy flat journals keep the old (level-free) table
+    legacy = mod._volume_lines([{"event": "volume_report", "step": 1,
+                                 "bucket": 0, "algo": "oktopk",
+                                 "mean_wire_bytes": 1.0,
+                                 "budget_bytes": 2.0,
+                                 "conformance_ratio": 0.5}])
+    assert not any("level" in ln for ln in legacy)
+
+
+# ---------------------------------------------------------------------------
+# plan-mode autotuning: preset fabric picks the level structure
+# ---------------------------------------------------------------------------
+
+class TestPlanModeAutotune:
+    N_PLAN = 1 << 20
+    P_PLAN = 32
+    PODS_PLAN = 4
+
+    def _tune(self, fabric):
+        from oktopk_tpu.autotune.policy import (Autotuner, AutotunePolicy,
+                                                Candidate)
+        pol = AutotunePolicy(candidates=(
+            Candidate("dense", 1.0), Candidate("oktopk", 0.01),
+            Candidate("hierarchical", 0.01, outer="oktopk")))
+        t = Autotuner([self.N_PLAN], num_workers=self.P_PLAN, policy=pol,
+                      runner=None, fabric=fabric, num_pods=self.PODS_PLAN)
+        plans = t.tune(step=0)
+        return plans[0], t.journal.entries
+
+    def test_dcn_selects_hierarchical(self):
+        plan, entries = self._tune("dcn")
+        assert plan.algo == "hierarchical" and plan.outer == "oktopk"
+        dec = [e for e in entries if e["event"] == "decision"][0]
+        assert dec["reason"] == "plan"
+        assert dec["fabric"] == "ici+dcn"
+        assert dec["num_pods"] == self.PODS_PLAN
+        # the journalled decision carries the per-level (algo, density)
+        levels = {d["level"]: d for d in dec["chosen"]["levels"]}
+        assert levels["intra"]["algo"] == "dense"
+        assert levels["inter"] == {"level": "inter", "algo": "oktopk",
+                                   "density": 0.01}
+        assert validate_event(dec) == []
+
+    def test_ici_selects_flat_dense(self):
+        plan, entries = self._tune("ici")
+        assert plan.algo == "dense" and plan.outer is None
+        dec = [e for e in entries if e["event"] == "decision"][0]
+        assert dec["chosen"] == {"algo": "dense", "density": 1.0}
+
+    def test_plan_mode_calibrates_from_preset(self):
+        from oktopk_tpu.autotune.policy import (Autotuner, AutotunePolicy,
+                                                Candidate)
+        pol = AutotunePolicy(candidates=(Candidate("dense", 1.0),))
+        t = Autotuner([1024], num_workers=8, policy=pol, runner=None,
+                      fabric="dcn", num_pods=2)
+        c = t.calibrate()
+        assert c.source == "preset:dcn"
+        assert c.alpha == pytest.approx(10e-6)
+
+    def test_runner_required_without_fabric(self):
+        from oktopk_tpu.autotune.policy import (Autotuner, AutotunePolicy,
+                                                Candidate)
+        pol = AutotunePolicy(candidates=(Candidate("dense", 1.0),))
+        with pytest.raises(ValueError, match="plan mode"):
+            Autotuner([1024], num_workers=8, policy=pol, runner=None)
+
+    def test_hierarchical_predict_needs_fabric(self):
+        from oktopk_tpu.autotune.policy import predict_ms
+        from oktopk_tpu.autotune.calibrate import default_coefficients
+        with pytest.raises(ValueError, match="fabric"):
+            predict_ms("hierarchical", 0.01, 1024, 8,
+                       default_coefficients())
+
+    def test_hierarchical_price_is_per_level_sum(self):
+        from oktopk_tpu.autotune.policy import predict_ms
+        tw = two_level("dcn")
+        n, p, pods, d = self.N_PLAN, self.P_PLAN, self.PODS_PLAN, 0.01
+        got = predict_ms("hierarchical", d, n, p, tw.inter.coefficients(),
+                         fabric=tw, num_pods=pods, outer="oktopk")
+        from oktopk_tpu.utils.cost_model import allreduce_cost
+        intra = allreduce_cost(n, p // pods, tw.intra.alpha_s,
+                               tw.intra.beta_elem()) * 1e3
+        outer = predict_ms("oktopk", d, n, pods, tw.inter.coefficients(),
+                           select_gamma=PLAN_SELECT_GAMMA)
+        assert got == pytest.approx(intra + outer)
+
+    def test_make_candidates_hierarchical_outers(self):
+        from oktopk_tpu.autotune.policy import make_candidates
+        cands = make_candidates(["dense"], [0.01, 0.02],
+                                hierarchical_outers=["oktopk"])
+        hier = [c for c in cands if c.algo == "hierarchical"]
+        assert {(c.density, c.outer) for c in hier} == {
+            (0.01, "oktopk"), (0.02, "oktopk")}
+
+
+# ---------------------------------------------------------------------------
+# anatomy: the optional level lane in phase scopes
+# ---------------------------------------------------------------------------
+
+class TestAnatomyLevelLane:
+    def test_scope_name_with_level(self):
+        from oktopk_tpu.obs.anatomy import parse_scope_level, scope_name
+        nm = scope_name("exchange", bucket=0, level=1)
+        assert nm == "anat/b000/lvl1/exchange"
+        assert parse_scope_level(nm) == ("exchange", 0, 1)
+        assert parse_scope_level("anat/b002/lvl0") == (None, 2, 0)
+
+    def test_legacy_names_round_trip_unchanged(self):
+        from oktopk_tpu.obs.anatomy import (parse_scope, parse_scope_level,
+                                            scope_name)
+        nm = scope_name("select", bucket=3)
+        assert nm == "anat/b003/select"
+        assert parse_scope(nm) == ("select", 3)
+        assert parse_scope_level(nm) == ("select", 3, None)
+        assert parse_scope("anat/exchange") == ("exchange", None)
+
+    def test_phase_totals_fold_levels(self):
+        from oktopk_tpu.obs.anatomy import phase_totals
+        analysis = {"buckets": {0: {
+            "lvl0/exchange": {"ms": 1.0, "count": 1, "lane": "comm"},
+            "lvl1/exchange": {"ms": 2.0, "count": 1, "lane": "comm"},
+            "select": {"ms": 0.5, "count": 1, "lane": "compute"}}}}
+        totals = phase_totals(analysis)
+        assert totals["exchange"] == pytest.approx(3.0)
+        assert totals["select"] == pytest.approx(0.5)
+
+    def test_hierarchical_program_carries_level_scopes(self, hmesh):
+        """The compiled two-level program names both level lanes (named
+        scopes only surface in compiled HLO op metadata, not in the
+        pre-compile stablehlo)."""
+        h = make_hierarchical_config(make_flat_cfg(density=0.05),
+                                     num_pods=PODS, outer="oktopk")
+        step = build_allreduce_step("hierarchical", h, hmesh, warmup=False)
+        g = jnp.zeros((P, N), jnp.float32)
+        txt = step.lower(g, batched_init_state(h)).compile().as_text()
+        assert "anat/b000/lvl0/exchange" in txt
+        assert "anat/b000/lvl1/" in txt
